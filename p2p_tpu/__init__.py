@@ -1,0 +1,14 @@
+"""p2p-tpu: a TPU-native (JAX/XLA/pallas/pjit) prompt-to-prompt image-editing framework.
+
+Re-designs the capabilities of KIMGEONUNG/prompt-to-prompt (attention Replace /
+Refine / Reweight edits, LocalBlend, attention-map storage/visualization, and
+null-text inversion) as a functionally pure, jit-compiled pipeline: the
+reference's runtime monkey-patching (`/root/reference/ptp_utils.py:175-242`)
+becomes a pluggable attention-controller applied inside our own Flax U-Net, with
+controller state threaded through a `lax.scan` sampling loop and data-parallel
+sharding over TPU meshes for seed / equalizer sweeps.
+"""
+
+__version__ = "0.1.0"
+
+MAX_NUM_WORDS = 77  # CLIP context length; the reference's `MAX_NUM_WORDS` (main.py:21)

@@ -1,0 +1,3 @@
+from .tokenizer import ClipBpeTokenizer, HashWordTokenizer, Tokenizer, pad_ids, token_strings
+
+__all__ = ["ClipBpeTokenizer", "HashWordTokenizer", "Tokenizer", "pad_ids", "token_strings"]

@@ -1,0 +1,46 @@
+"""Test configuration: force an 8-virtual-device CPU JAX platform.
+
+Multi-chip sharding paths are exercised on a virtual CPU mesh
+(`--xla_force_host_platform_device_count=8`); real-TPU execution is covered by
+`bench.py` / `__graft_entry__.py`, which the driver runs on hardware.
+These env vars must be set before the first `import jax` anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tokenizer():
+    from p2p_tpu.utils.tokenizer import HashWordTokenizer
+
+    return HashWordTokenizer()
+
+
+REFERENCE_DIR = "/root/reference"
+
+
+@pytest.fixture(scope="session")
+def reference_modules():
+    """Import the reference's host-side modules (torch CPU) for golden parity
+    checks. Skips cleanly when the reference checkout is not present."""
+    if not os.path.isdir(REFERENCE_DIR):
+        pytest.skip("reference checkout not available")
+    sys.path.insert(0, REFERENCE_DIR)
+    try:
+        import seq_aligner as ref_seq_aligner  # noqa: F401
+    except Exception as e:  # pragma: no cover
+        pytest.skip(f"reference import failed: {e}")
+    finally:
+        sys.path.remove(REFERENCE_DIR)
+    return {"seq_aligner": ref_seq_aligner}

@@ -1,0 +1,172 @@
+"""Golden tests for the alignment precompute layer.
+
+Hand-derived cases plus direct parity against the reference's
+`seq_aligner.py` (imported from /root/reference, run on torch-CPU) using the
+same tokenizer on both sides.
+"""
+
+import numpy as np
+import pytest
+
+from p2p_tpu.align import (
+    get_equalizer,
+    get_refinement_mapper,
+    get_replacement_mapper,
+    get_time_words_attention_alpha,
+    get_word_inds,
+    needleman_wunsch,
+)
+from p2p_tpu.utils.tokenizer import HashWordTokenizer
+
+
+def test_word_inds_basic(tokenizer):
+    text = "a cat sat on the mat"
+    assert list(get_word_inds(text, 1, tokenizer)) == [2]
+    assert list(get_word_inds(text, "mat", tokenizer)) == [6]
+    assert list(get_word_inds(text, "dog", tokenizer)) == []
+
+
+def test_word_inds_multitoken(tokenizer):
+    # 'extraordinarily' (15 chars) splits into two 8-char hash pieces.
+    text = "an extraordinarily big cat"
+    inds = get_word_inds(text, 1, tokenizer)
+    assert list(inds) == [2, 3]
+    assert list(get_word_inds(text, "cat", tokenizer)) == [5]
+
+
+def test_needleman_wunsch_identity():
+    pairs = needleman_wunsch([0, 5, 6, 7, 1], [0, 5, 6, 7, 1])
+    assert pairs == [(0, 0), (1, 1), (2, 2), (3, 3), (4, 4)]
+
+
+def test_needleman_wunsch_insertion():
+    # y inserts token 9 between 5 and 6 -> that position maps to -1.
+    pairs = needleman_wunsch([0, 5, 6, 1], [0, 5, 9, 6, 1])
+    assert (2, -1) in pairs
+    ys = [p[0] for p in pairs]
+    assert ys == sorted(ys)
+
+
+def test_refinement_mapper_shapes_and_alphas(tokenizer):
+    prompts = ["a cat sat", "a fluffy cat sat"]
+    mapper, alphas = get_refinement_mapper(prompts, tokenizer, max_len=16)
+    assert mapper.shape == (1, 16)
+    assert alphas.shape == (1, 16)
+    # 'fluffy' is new: exactly one aligned position has alpha 0.
+    n_new = int((alphas[0][: len(tokenizer.encode(prompts[1]))] == 0).sum())
+    assert n_new == 1
+    # Existing tokens gather from their source positions.
+    assert mapper[0, 0] == 0  # BOS -> BOS
+
+
+def test_replacement_mapper_identity_when_equal(tokenizer):
+    prompts = ["a cat sat", "a cat sat"]
+    m = get_replacement_mapper(prompts, tokenizer, max_len=12)[0]
+    assert np.allclose(m, np.eye(12))
+
+
+def test_replacement_mapper_single_swap(tokenizer):
+    prompts = ["a cat sat", "a dog sat"]
+    m = get_replacement_mapper(prompts, tokenizer, max_len=12)[0]
+    # one-token word swap at token index 2 -> still a permutation-ish identity
+    assert m[2, 2] == 1.0
+    assert np.allclose(np.delete(np.delete(m, 2, 0), 2, 1), np.eye(11))
+
+
+def test_replacement_mapper_word_count_mismatch_raises(tokenizer):
+    with pytest.raises(ValueError):
+        get_replacement_mapper(["a cat", "a big cat"], tokenizer)
+
+
+def test_time_words_alpha_float(tokenizer):
+    prompts = ["a cat", "a dog"]
+    alpha = get_time_words_attention_alpha(prompts, 10, 0.8, tokenizer, max_num_words=8)
+    assert alpha.shape == (11, 1, 1, 1, 8)
+    # float bounds -> window [0, int(0.8*11)) = [0, 8)
+    assert alpha[:8].min() == 1.0
+    assert alpha[8:].max() == 0.0
+
+
+def test_time_words_alpha_per_word(tokenizer):
+    prompts = ["a cat sat", "a dog sat"]
+    alpha = get_time_words_attention_alpha(
+        prompts, 9, {"default_": 1.0, "dog": (0.0, 0.5)}, tokenizer, max_num_words=8
+    )
+    dog_ind = get_word_inds(prompts[1], "dog", tokenizer)[0]
+    assert alpha[0, 0, 0, 0, dog_ind] == 1.0
+    assert alpha[6, 0, 0, 0, dog_ind] == 0.0  # past the (0, .5) window
+    other = 1 if dog_ind != 1 else 3
+    assert alpha[6, 0, 0, 0, other] == 1.0  # default window still active
+
+
+def test_equalizer_sweep(tokenizer):
+    text = "a very fluffy cat"
+    eq = get_equalizer(text, "fluffy", [2.0, 0.5, 1.0], tokenizer, mode="sweep")
+    assert eq.shape == (3, tokenizer.model_max_length)
+    ind = get_word_inds(text, "fluffy", tokenizer)[0]
+    assert eq[0, ind] == 2.0 and eq[1, ind] == 0.5 and eq[2, ind] == 1.0
+    assert eq[0, 0] == 1.0
+
+
+def test_equalizer_paired(tokenizer):
+    text = "a very fluffy cat"
+    eq = get_equalizer(text, ("fluffy", "cat"), (3.0, 0.2), tokenizer, mode="paired")
+    assert eq.shape == (1, tokenizer.model_max_length)
+    assert eq[0, get_word_inds(text, "fluffy", tokenizer)[0]] == 3.0
+    assert eq[0, get_word_inds(text, "cat", tokenizer)[0]] == 0.2
+
+
+# ---------------------------------------------------------------------------
+# Parity vs the reference implementation (same tokenizer on both sides)
+# ---------------------------------------------------------------------------
+
+PROMPT_PAIRS = [
+    ("a cat sat on the mat", "a dog sat on the mat"),
+    ("a cat sat on the mat", "a extraordinarily dog sat on the mat"),
+    ("photo of a house", "painting of a house"),
+    ("a cat", "a cat"),
+]
+
+
+@pytest.mark.parametrize("src,tgt", PROMPT_PAIRS)
+def test_refinement_parity_with_reference(reference_modules, tokenizer, src, tgt):
+    ref = reference_modules["seq_aligner"]
+    ref_mapper, ref_alphas = ref.get_refinement_mapper([src, tgt], tokenizer, max_len=77)
+    mapper, alphas = get_refinement_mapper([src, tgt], tokenizer, max_len=77)
+    np.testing.assert_array_equal(mapper[0], ref_mapper[0].numpy())
+    np.testing.assert_array_equal(alphas[0], ref_alphas[0].numpy())
+
+
+@pytest.mark.parametrize(
+    "src,tgt",
+    [
+        ("a cat sat on the mat", "a dog sat on the mat"),
+        ("a photograph of a castle", "a painting of a castle"),
+        # multi-token word swap (different token counts per word)
+        ("a cat sat", "a pterodactylus sat"),
+    ],
+)
+def test_replacement_parity_with_reference(reference_modules, tokenizer, src, tgt):
+    ref = reference_modules["seq_aligner"]
+    ref_m = ref.get_replacement_mapper([src, tgt], tokenizer, max_len=77)[0].numpy()
+    m = get_replacement_mapper([src, tgt], tokenizer, max_len=77)[0]
+    np.testing.assert_allclose(m, ref_m, atol=1e-6)
+
+
+def test_word_inds_parity_with_reference(reference_modules, tokenizer):
+    ref = reference_modules["seq_aligner"]
+    for text in ["a cat sat on the mat", "an extraordinarily big castle next to a river"]:
+        for place in range(len(text.split())):
+            np.testing.assert_array_equal(
+                get_word_inds(text, place, tokenizer),
+                ref.get_word_inds(text, place, tokenizer),
+            )
+
+
+def test_hash_tokenizer_roundtrip():
+    tok = HashWordTokenizer()
+    ids = tok.encode("a fluffy cat")
+    assert ids[0] == tok.bos_token_id and ids[-1] == tok.eos_token_id
+    assert tok.decode(ids) == "a fluffy cat"
+    batch = tok(["a cat", "a dog"], max_length=8)["input_ids"]
+    assert len(batch) == 2 and all(len(r) == 8 for r in batch)
