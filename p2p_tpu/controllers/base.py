@@ -1,0 +1,258 @@
+"""Functional attention-controller core.
+
+The reference's deep idea is a *pure function* from (attention probabilities,
+layer position, step) to attention probabilities, plus a latent post-step hook,
+with every edit parameter precomputed host-side (`/root/reference/main.py:69-290`).
+Its implementation, however, is stateful: runtime monkey-patching installs a
+hook (`/root/reference/ptp_utils.py:175-242`) and `cur_step`/`cur_att_layer`
+counters plus a dict-of-lists attention store carry the bookkeeping
+(`/root/reference/main.py:85-159`).
+
+Here that becomes explicit functional state:
+
+- **Layer position is static.** Each attention call site in our U-Net knows
+  its :class:`AttnMeta` at trace time (place / is_cross / resolution /
+  store slot), replacing the runtime registration walk and the
+  ``cur_att_layer`` counter.
+- **The step index is threaded by ``lax.scan``** — no ``cur_step`` mutation.
+- **The store is a tuple of fixed-shape arrays** (one per stored layer),
+  accumulated by addition across steps — replacing the growing
+  ``{down,mid,up}_{cross,self}`` lists (`/root/reference/main.py:118-142`).
+- **Controllers are pytrees** (`flax.struct`) passed as arguments into the
+  jitted sampling loop; an "empty" controller compiles away to the identity,
+  making `EmptyControl ≡ no controller` true at the XLA-program level.
+
+Attention tensors here have shape ``(2B, heads, P, K)`` — the full
+classifier-free-guidance batch ``[uncond(B); cond(B)]`` with ``B = 1 + E``
+(source prompt + E edit prompts). Edits touch only the conditional half, and
+within it only rows ``1:`` (the edit prompts), exactly as
+`/root/reference/main.py:90-92,187` does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .edit import EditParams, edit_cross_attention, edit_self_attention
+from .blend import BlendParams, apply_local_blend
+
+
+@dataclasses.dataclass(frozen=True)
+class AttnMeta:
+    """Static description of one attention call site inside the U-Net.
+
+    Replaces the runtime layer walk + counting of
+    `/root/reference/ptp_utils.py:223-242`: the structure is known at trace
+    time, so layer bookkeeping costs nothing in the compiled program.
+    """
+
+    layer_idx: int          # global index over all attention call sites
+    place: str              # 'down' | 'mid' | 'up'
+    is_cross: bool
+    resolution: int         # spatial side length of the feature map (pixels = resolution²)
+    heads: int
+    key_len: int            # K (= 77 for cross, = resolution² for self)
+    store_slot: Optional[int] = None  # index into the store state, or None
+
+    @property
+    def pixels(self) -> int:
+        return self.resolution * self.resolution
+
+
+@dataclasses.dataclass(frozen=True)
+class StoreConfig:
+    """What the attention store keeps.
+
+    The reference always stores every ≤32²-pixel map
+    (`/root/reference/main.py:131`); we additionally allow switching off
+    self/cross storage independently so edit-only runs (which need just the
+    16×16 cross maps for LocalBlend) don't pay ~300MB of self-attention
+    accumulation bandwidth.
+    """
+
+    max_pixels: int = 32 * 32
+    store_cross: bool = True
+    store_self: bool = True
+
+    def wants(self, meta: "AttnMeta") -> bool:
+        if meta.pixels > self.max_pixels:
+            return False
+        return self.store_cross if meta.is_cross else self.store_self
+
+
+@dataclasses.dataclass(frozen=True)
+class AttnLayout:
+    """The full static attention structure of a model: one AttnMeta per call
+    site, with store slots assigned. Built once per (model, StoreConfig)."""
+
+    metas: Tuple[AttnMeta, ...]
+    store_cfg: StoreConfig
+
+    @property
+    def num_store_slots(self) -> int:
+        return sum(1 for m in self.metas if m.store_slot is not None)
+
+    def stored_metas(self) -> Tuple[AttnMeta, ...]:
+        return tuple(m for m in self.metas if m.store_slot is not None)
+
+    def blend_metas(self, resolution: int = 16) -> Tuple[AttnMeta, ...]:
+        """The cross-attention maps LocalBlend consumes — all cross sites at
+        ``resolution`` (for SD-1.4 this is exactly the reference's
+        ``down_cross[2:4] + up_cross[:3]`` slice, `/root/reference/main.py:37-38`,
+        but derived from the model rather than hard-coded)."""
+        return tuple(
+            m for m in self.metas
+            if m.is_cross and m.resolution == resolution and m.store_slot is not None
+        )
+
+
+def build_layout(
+    specs: Sequence[Tuple[str, bool, int, int, int]],
+    store_cfg: StoreConfig = StoreConfig(),
+) -> AttnLayout:
+    """Assemble an :class:`AttnLayout` from ``(place, is_cross, resolution,
+    heads, key_len)`` tuples in call order, assigning store slots to the sites
+    the :class:`StoreConfig` wants."""
+    metas = []
+    slot = 0
+    for idx, (place, is_cross, resolution, heads, key_len) in enumerate(specs):
+        meta = AttnMeta(idx, place, is_cross, resolution, heads, key_len)
+        if store_cfg.wants(meta):
+            meta = dataclasses.replace(meta, store_slot=slot)
+            slot += 1
+        metas.append(meta)
+    return AttnLayout(tuple(metas), store_cfg)
+
+
+@struct.dataclass
+class Controller:
+    """A prompt-to-prompt controller as a pytree.
+
+    ``edit``/``blend`` are parameter pytrees (or None); the remaining fields
+    are static. The all-None controller is the identity (EmptyControl,
+    `/root/reference/main.py:110-113`); ``store=True`` alone reproduces
+    AttentionStore; ``spatial_stop_inject`` reproduces SpatialReplace
+    (`/root/reference/null_text.py:158-168`).
+    """
+
+    edit: Optional[EditParams] = None
+    blend: Optional[BlendParams] = None
+    # Scalar leaf (traced) when present, so the injection horizon can sweep
+    # without recompiling; None disables the SpatialReplace path statically.
+    spatial_stop_inject: Optional[jax.Array] = None
+    store: bool = struct.field(pytree_node=False, default=False)
+
+    @property
+    def is_identity(self) -> bool:
+        return (
+            self.edit is None
+            and self.blend is None
+            and not self.store
+            and self.spatial_stop_inject is None
+        )
+
+    @property
+    def needs_store(self) -> bool:
+        return self.store or self.blend is not None
+
+
+StoreState = Tuple[jax.Array, ...]
+
+
+def init_store_state(
+    layout: AttnLayout, batch_cond: int, dtype=jnp.float32
+) -> StoreState:
+    """Zero-initialized accumulation buffers, one per stored call site:
+    ``(B_cond, heads, pixels, key_len)`` each. Fixed shapes — the jit-friendly
+    replacement for `/root/reference/main.py:118-127`'s dict of lists."""
+    return tuple(
+        jnp.zeros((batch_cond, m.heads, m.pixels, m.key_len), dtype=dtype)
+        for m in layout.stored_metas()
+    )
+
+
+def empty_store_state() -> StoreState:
+    return ()
+
+
+def apply_attention_control(
+    controller: Optional[Controller],
+    meta: AttnMeta,
+    state: StoreState,
+    attn: jax.Array,
+    step: jax.Array,
+) -> Tuple[StoreState, jax.Array]:
+    """The per-layer hook: store (pre-edit) then edit the conditional half.
+
+    ``attn``: softmax probabilities, shape ``(2B, heads, P, K)``. Mirrors the
+    call path `/root/reference/main.py:85-98` → `main.py:180-197`, with the
+    store-then-edit order of `main.py:181` preserved (stored maps are
+    pre-edit). Everything branching on ``meta`` or controller structure is
+    static, so the identity controller adds zero ops to the compiled program.
+    """
+    if controller is None or controller.is_identity:
+        return state, attn
+
+    two_b = attn.shape[0]
+    b = two_b // 2
+    cond = attn[b:]
+
+    if meta.store_slot is not None and controller.needs_store:
+        lst = list(state)
+        lst[meta.store_slot] = lst[meta.store_slot] + cond.astype(lst[meta.store_slot].dtype)
+        state = tuple(lst)
+
+    if controller.edit is not None and b > 1:
+        base, edits = cond[0], cond[1:]
+        if meta.is_cross:
+            new_edits = edit_cross_attention(controller.edit, base, edits, step)
+        else:
+            new_edits = edit_self_attention(controller.edit, base, edits, step, meta.pixels)
+        cond = jnp.concatenate([base[None], new_edits.astype(attn.dtype)], axis=0)
+        attn = jnp.concatenate([attn[:b], cond], axis=0)
+
+    return state, attn
+
+
+def apply_step_callback(
+    controller: Optional[Controller],
+    layout: AttnLayout,
+    state: StoreState,
+    x_t: jax.Array,
+    step: jax.Array,
+) -> jax.Array:
+    """Post-scheduler-step latent hook: SpatialReplace injection and/or
+    LocalBlend compositing (`/root/reference/main.py:164-167`,
+    `/root/reference/null_text.py:158-168`)."""
+    if controller is None or controller.is_identity:
+        return x_t
+
+    if controller.spatial_stop_inject is not None:
+        injected = jnp.broadcast_to(x_t[:1], x_t.shape)
+        x_t = jnp.where(step < controller.spatial_stop_inject, injected, x_t)
+
+    if controller.blend is not None:
+        x_t = apply_local_blend(controller.blend, layout, state, x_t, step)
+
+    return x_t
+
+
+def average_attention(
+    layout: AttnLayout, state: StoreState, num_steps: int
+) -> dict:
+    """Average stored maps over steps, returned as the reference's
+    ``{place}_{kind}`` dict of lists (`/root/reference/main.py:144-149`) for
+    the visualization layer."""
+    out: dict = {
+        "down_cross": [], "mid_cross": [], "up_cross": [],
+        "down_self": [], "mid_self": [], "up_self": [],
+    }
+    for m in layout.stored_metas():
+        key = f"{m.place}_{'cross' if m.is_cross else 'self'}"
+        out[key].append(state[m.store_slot] / num_steps)
+    return out
