@@ -187,13 +187,20 @@ def apply_attention_control(
     attn: jax.Array,
     step: jax.Array,
 ) -> Tuple[StoreState, jax.Array]:
-    """The per-layer hook: store (pre-edit) then edit the conditional half.
+    """The per-layer hook: edit the conditional half, then store the
+    *post-edit* maps.
 
     ``attn``: softmax probabilities, shape ``(2B, heads, P, K)``. Mirrors the
-    call path `/root/reference/main.py:85-98` → `main.py:180-197`, with the
-    store-then-edit order of `main.py:181` preserved (stored maps are
-    pre-edit). Everything branching on ``meta`` or controller structure is
-    static, so the identity controller adds zero ops to the compiled program.
+    call path `/root/reference/main.py:85-98` → `main.py:180-197`. Ordering
+    note: the reference *appears* to store before editing
+    (`main.py:181` calls the store superclass first), but it appends the
+    cond-half tensor **by reference** and then mutates it in place
+    (`main.py:186,193` write through a reshape view of the same storage) —
+    so what its store, LocalBlend, and visualizations actually see is the
+    edited attention for rows 1:. We reproduce that observable behavior
+    explicitly: edit first, store the result. Everything branching on
+    ``meta`` or controller structure is static, so the identity controller
+    adds zero ops to the compiled program.
     """
     if controller is None or controller.is_identity:
         return state, attn
@@ -201,11 +208,6 @@ def apply_attention_control(
     two_b = attn.shape[0]
     b = two_b // 2
     cond = attn[b:]
-
-    if meta.store_slot is not None and controller.needs_store:
-        lst = list(state)
-        lst[meta.store_slot] = lst[meta.store_slot] + cond.astype(lst[meta.store_slot].dtype)
-        state = tuple(lst)
 
     if controller.edit is not None and b > 1:
         base, edits = cond[0], cond[1:]
@@ -215,6 +217,11 @@ def apply_attention_control(
             new_edits = edit_self_attention(controller.edit, base, edits, step, meta.pixels)
         cond = jnp.concatenate([base[None], new_edits.astype(attn.dtype)], axis=0)
         attn = jnp.concatenate([attn[:b], cond], axis=0)
+
+    if meta.store_slot is not None and controller.needs_store:
+        lst = list(state)
+        lst[meta.store_slot] = lst[meta.store_slot] + cond.astype(lst[meta.store_slot].dtype)
+        state = tuple(lst)
 
     return state, attn
 

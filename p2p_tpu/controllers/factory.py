@@ -168,10 +168,10 @@ def attention_reweight(
             # applies both equalizers (`/root/reference/main.py:258-263`);
             # per-token scales compose multiplicatively.
             eq = eq * base.edit.equalizer
-        if local_blend is None:
-            local_blend = base.blend
     else:
         kind, mapper, refine_alphas = "none", None, None
+    if base is not None and local_blend is None:
+        local_blend = base.blend
     edit = EditParams(
         cross_alpha=_cross_alpha(prompts, num_steps, cross_replace_steps, tokenizer, L),
         mapper=mapper,

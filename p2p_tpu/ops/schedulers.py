@@ -1,0 +1,264 @@
+"""Diffusion schedulers as pure JAX — scan-friendly, stateless where possible.
+
+Two samplers cover the reference's paths:
+
+- **DDIM** (η=0) — the null-text path's scheduler
+  (`/root/reference/null_text.py:16-20`), whose closed-form ``prev_step`` /
+  ``next_step`` updates (`/root/reference/null_text.py:471-489`) are the
+  numeric spec here, including ``set_alpha_to_one=False`` semantics (the
+  final step uses ``alphas_cumprod[0]``, not 1).
+- **PLMS** (PNDM with ``skip_prk_steps``) — the scheduler the reference CLI
+  inherits from the SD pipeline (`/root/reference/main.py:29` keeps the
+  pipeline default; noted at SURVEY §2.14). Implemented from the published
+  pseudo-linear-multistep method (Liu et al., arXiv 2202.09778): an
+  Adams–Bashforth combination over a ring buffer of the last 4 ε-predictions,
+  carried explicitly through the scan instead of Python-side lists/counters.
+
+Both share a :class:`DiffusionSchedule` of precomputed constants; per-step
+updates index it with the traced timestep, so one compiled program serves any
+step count with the same shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+def make_betas(
+    num_train_timesteps: int = 1000,
+    beta_start: float = 0.00085,
+    beta_end: float = 0.012,
+    schedule: str = "scaled_linear",
+) -> np.ndarray:
+    """The SD-1.x β schedule (defaults from `/root/reference/null_text.py:16-18`)."""
+    if schedule == "scaled_linear":
+        return np.linspace(beta_start ** 0.5, beta_end ** 0.5, num_train_timesteps,
+                           dtype=np.float64) ** 2
+    if schedule == "linear":
+        return np.linspace(beta_start, beta_end, num_train_timesteps, dtype=np.float64)
+    raise ValueError(f"unknown beta schedule: {schedule!r}")
+
+
+@struct.dataclass
+class DiffusionSchedule:
+    """Precomputed constants shared by all samplers.
+
+    ``timesteps`` descend (sampling order). ``final_alpha_cumprod`` encodes
+    ``set_alpha_to_one``: for SD it is ``alphas_cumprod[0]``
+    (`/root/reference/null_text.py:20` sets ``set_alpha_to_one=False``).
+    """
+
+    alphas_cumprod: jax.Array            # (num_train,)
+    timesteps: jax.Array                 # (num_sampling_iters,) int32, descending
+    final_alpha_cumprod: jax.Array       # scalar
+    num_train_timesteps: int = struct.field(pytree_node=False, default=1000)
+    num_inference_steps: int = struct.field(pytree_node=False, default=50)
+
+    @property
+    def step_size(self) -> int:
+        return self.num_train_timesteps // self.num_inference_steps
+
+
+def make_schedule(
+    num_inference_steps: int,
+    num_train_timesteps: int = 1000,
+    beta_start: float = 0.00085,
+    beta_end: float = 0.012,
+    schedule: str = "scaled_linear",
+    set_alpha_to_one: bool = False,
+    steps_offset: int = 0,
+    kind: str = "ddim",
+    dtype=jnp.float32,
+) -> DiffusionSchedule:
+    """Build a :class:`DiffusionSchedule`.
+
+    ``kind='ddim'``: T timesteps ``[(T-1)·s, ..., 0] + offset``.
+    ``kind='plms'``: T+1 timesteps with the second one repeated — the
+    warm-up double-evaluation of the first step that PLMS needs to build its
+    multistep history (so a 50-step PLMS run makes 51 U-Net calls, matching
+    the reference pipeline's loop over ``scheduler.timesteps``).
+    """
+    betas = make_betas(num_train_timesteps, beta_start, beta_end, schedule)
+    acp = np.cumprod(1.0 - betas)
+    step = num_train_timesteps // num_inference_steps
+    base = (np.arange(num_inference_steps) * step).round().astype(np.int64) + steps_offset
+    if kind == "ddim":
+        ts = base[::-1].copy()
+    elif kind == "plms":
+        ts = np.concatenate([base[:-1], base[-2:-1], base[-1:]])[::-1].copy()
+    else:
+        raise ValueError(f"unknown schedule kind: {kind!r}")
+    final = acp[0] if not set_alpha_to_one else 1.0
+    return DiffusionSchedule(
+        alphas_cumprod=jnp.asarray(acp, dtype=dtype),
+        timesteps=jnp.asarray(ts, dtype=jnp.int32),
+        final_alpha_cumprod=jnp.asarray(final, dtype=dtype),
+        num_train_timesteps=num_train_timesteps,
+        num_inference_steps=num_inference_steps,
+    )
+
+
+def _alpha_at(sched: DiffusionSchedule, t: jax.Array) -> jax.Array:
+    """``alphas_cumprod[t]`` with t<0 mapping to ``final_alpha_cumprod``
+    (`/root/reference/null_text.py:474`)."""
+    safe_t = jnp.clip(t, 0, sched.num_train_timesteps - 1)
+    return jnp.where(t >= 0, sched.alphas_cumprod[safe_t], sched.final_alpha_cumprod)
+
+
+# ---------------------------------------------------------------------------
+# DDIM (η = 0)
+# ---------------------------------------------------------------------------
+
+
+def ddim_step(
+    sched: DiffusionSchedule, eps: jax.Array, t: jax.Array, sample: jax.Array
+) -> jax.Array:
+    """One deterministic DDIM denoising step x_t → x_{t-Δ}
+    (`/root/reference/null_text.py:471-479`)."""
+    prev_t = t - sched.step_size
+    a_t = _alpha_at(sched, t)
+    a_prev = _alpha_at(sched, prev_t)
+    pred_x0 = (sample - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
+    direction = jnp.sqrt(1.0 - a_prev) * eps
+    return jnp.sqrt(a_prev) * pred_x0 + direction
+
+
+def ddim_next_step(
+    sched: DiffusionSchedule, eps: jax.Array, t: jax.Array, sample: jax.Array
+) -> jax.Array:
+    """One DDIM *inversion* step x_t → x_{t+Δ} — the forward closed-form
+    ascent used by null-text inversion (`/root/reference/null_text.py:481-489`)."""
+    cur_t = jnp.minimum(t - sched.step_size, sched.num_train_timesteps - 1)
+    next_t = t
+    a_t = _alpha_at(sched, cur_t)
+    a_next = _alpha_at(sched, next_t)
+    pred_x0 = (sample - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
+    direction = jnp.sqrt(1.0 - a_next) * eps
+    return jnp.sqrt(a_next) * pred_x0 + direction
+
+
+# ---------------------------------------------------------------------------
+# PLMS (pseudo linear multistep; PNDM with prk steps skipped)
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class PlmsState:
+    """Scan-carried multistep history: ring buffer of the last 4 ε's, the
+    evaluation counter, and the saved sample for the warm-up double-step."""
+
+    ets: jax.Array        # (4, *sample_shape) — newest at index 0
+    counter: jax.Array    # int32 scalar
+    cur_sample: jax.Array  # sample saved at counter==0
+
+
+def init_plms_state(sample_shape: Tuple[int, ...], dtype=jnp.float32) -> PlmsState:
+    return PlmsState(
+        ets=jnp.zeros((4,) + tuple(sample_shape), dtype=dtype),
+        counter=jnp.int32(0),
+        cur_sample=jnp.zeros(sample_shape, dtype=dtype),
+    )
+
+
+def _plms_prev_sample(sched, sample, t, prev_t, eps):
+    """The PNDM transfer formula φ(x, t, t-Δ, ε) (Liu et al., eq. 11)."""
+    a_t = _alpha_at(sched, t)
+    a_prev = _alpha_at(sched, prev_t)
+    b_t = 1.0 - a_t
+    b_prev = 1.0 - a_prev
+    sample_coeff = jnp.sqrt(a_prev / a_t)
+    denom = a_t * jnp.sqrt(b_prev) + jnp.sqrt(a_t * b_t * a_prev)
+    return sample_coeff * sample - (a_prev - a_t) * eps / denom
+
+
+def plms_step(
+    sched: DiffusionSchedule,
+    state: PlmsState,
+    eps: jax.Array,
+    t: jax.Array,
+    sample: jax.Array,
+) -> Tuple[PlmsState, jax.Array]:
+    """One PLMS step, branch-free over the warm-up phases.
+
+    Evaluation counter c selects the ε combination (Adams–Bashforth orders
+    1→4): c=0 raw ε (and the sample is saved for the re-evaluation), c=1
+    average with the stored ε stepping from the *same* timestep, c=2/3/≥4
+    the 2nd/3rd/4th-order combinations. History updates only when c≠1.
+    """
+    c = state.counter
+    e1, e2, e3, e4 = state.ets[0], state.ets[1], state.ets[2], state.ets[3]
+
+    # Timestep bookkeeping: at c==1 we re-evaluate the first step, stepping
+    # from t+Δ to t+Δ-Δ = t's original position.
+    prev_t = jnp.where(c == 1, t, t - sched.step_size)
+    t_eff = jnp.where(c == 1, t + sched.step_size, t)
+
+    # ε history push (skipped at c==1).
+    new_ets = jnp.where(
+        c == 1,
+        state.ets,
+        jnp.stack([eps, e1, e2, e3]),
+    )
+    ne1, ne2, ne3, ne4 = new_ets[0], new_ets[1], new_ets[2], new_ets[3]
+
+    order = jnp.minimum(c, 4)
+    eps_used = jax.lax.switch(
+        order,
+        [
+            lambda: ne1,                                   # c=0: raw ε (just pushed)
+            lambda: (eps + e1) / 2.0,                      # c=1: avg with stored ε
+            lambda: (3.0 * ne1 - ne2) / 2.0,               # c=2
+            lambda: (23.0 * ne1 - 16.0 * ne2 + 5.0 * ne3) / 12.0,   # c=3
+            lambda: (55.0 * ne1 - 59.0 * ne2 + 37.0 * ne3 - 9.0 * ne4) / 24.0,
+        ],
+    )
+    sample_used = jnp.where(c == 1, state.cur_sample, sample)
+    new_cur = jnp.where(c == 0, sample, state.cur_sample)
+
+    prev_sample = _plms_prev_sample(sched, sample_used, t_eff, prev_t, eps_used)
+    return (
+        PlmsState(ets=new_ets, counter=c + 1, cur_sample=new_cur),
+        prev_sample,
+    )
+
+
+# ---------------------------------------------------------------------------
+# DDPM (ancestral) — completes the family; useful for training-time sampling
+# ---------------------------------------------------------------------------
+
+
+def ddpm_step(
+    sched: DiffusionSchedule,
+    eps: jax.Array,
+    t: jax.Array,
+    sample: jax.Array,
+    rng: jax.Array,
+) -> jax.Array:
+    """One ancestral DDPM step with the ``fixed_small`` posterior variance."""
+    prev_t = t - sched.step_size
+    a_t = _alpha_at(sched, t)
+    a_prev = _alpha_at(sched, prev_t)
+    alpha_ratio = a_t / a_prev
+    beta_t = 1.0 - alpha_ratio
+    pred_x0 = (sample - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
+    x0_coeff = jnp.sqrt(a_prev) * beta_t / (1.0 - a_t)
+    xt_coeff = jnp.sqrt(alpha_ratio) * (1.0 - a_prev) / (1.0 - a_t)
+    mean = x0_coeff * pred_x0 + xt_coeff * sample
+    var = beta_t * (1.0 - a_prev) / (1.0 - a_t)
+    noise = jax.random.normal(rng, sample.shape, dtype=sample.dtype)
+    return jnp.where(prev_t >= 0, mean + jnp.sqrt(jnp.maximum(var, 0.0)) * noise, mean)
+
+
+def add_noise(
+    sched: DiffusionSchedule, x0: jax.Array, noise: jax.Array, t: jax.Array
+) -> jax.Array:
+    """Forward q(x_t | x_0) sample — the training-time corruption."""
+    a_t = _alpha_at(sched, t)
+    while a_t.ndim < x0.ndim:
+        a_t = a_t[..., None]
+    return jnp.sqrt(a_t) * x0 + jnp.sqrt(1.0 - a_t) * noise

@@ -152,7 +152,7 @@ def test_identity_controller_is_noop_and_free():
     assert out3 is attn
 
 
-def test_store_accumulates_cond_half_pre_edit():
+def test_store_accumulates_cond_half():
     layout = tiny_layout()
     tok_steps = 3
     c = attention_store()
@@ -171,6 +171,34 @@ def test_store_accumulates_cond_half_pre_edit():
         rtol=1e-5,
     )
     assert len(avg["mid_cross"]) == 1 and len(avg["up_self"]) == 1
+
+
+def test_store_holds_post_edit_maps(tokenizer):
+    """The reference's store aliases the tensor the edit mutates in place
+    (main.py:132 append + main.py:193 in-place write), so stored edit rows are
+    post-edit; the base row is untouched."""
+    layout = tiny_layout()
+    prompts = ["a cat sat", "a dog sat", "a pig sat"]
+    c = attention_replace(prompts, 4, 1.0, 1.0, tokenizer, max_len=L)
+    c = Controller(edit=c.edit, store=True)
+    state = init_store_state(layout, batch_cond=B)
+    meta = layout.metas[0]  # cross
+    attn = rand_attn(jax.random.PRNGKey(7), meta)
+    state, out = apply_attention_control(c, meta, state, attn, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(state[0]), np.asarray(out[B:]), rtol=1e-6)
+    assert not np.allclose(np.asarray(state[0][1]), np.asarray(attn[B + 1]))
+    np.testing.assert_allclose(np.asarray(state[0][0]), np.asarray(attn[B]), rtol=1e-6)
+
+
+def test_reweight_inherits_blend_from_editless_base(tokenizer):
+    from p2p_tpu.controllers import attention_reweight, local_blend as mk_blend
+
+    prompts = ["a cat sat", "a dog sat"]
+    lb = mk_blend(prompts, ["cat", "dog"], tokenizer, num_steps=4, resolution=8, max_len=L)
+    base = Controller(blend=lb, store=True)
+    eq = np.ones((1, L), dtype=np.float32)
+    c = attention_reweight(prompts, 4, 1.0, 0.0, eq, tokenizer, base=base)
+    assert c.blend is not None
 
 
 def test_uncond_half_never_edited(tokenizer):

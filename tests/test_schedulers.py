@@ -1,0 +1,218 @@
+"""Scheduler numerics: closed-form oracles, inversion round-trips, exact-noise
+recovery, and a list-based PLMS simulator oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2p_tpu.ops.schedulers import (
+    DiffusionSchedule,
+    add_noise,
+    ddim_next_step,
+    ddim_step,
+    ddpm_step,
+    init_plms_state,
+    make_betas,
+    make_schedule,
+    plms_step,
+)
+
+
+def test_betas_scaled_linear_endpoints():
+    b = make_betas()
+    assert abs(b[0] - 0.00085) < 1e-12
+    assert abs(b[-1] - 0.012) < 1e-12
+    assert b.shape == (1000,)
+
+
+def test_ddim_timesteps_descend_and_offset():
+    s = make_schedule(50)
+    ts = np.asarray(s.timesteps)
+    assert ts[0] == 980 and ts[-1] == 0 and len(ts) == 50
+    s1 = make_schedule(50, steps_offset=1)
+    assert np.asarray(s1.timesteps)[0] == 981
+
+
+def test_plms_timesteps_repeat_second():
+    s = make_schedule(50, kind="plms")
+    ts = np.asarray(s.timesteps)
+    assert len(ts) == 51
+    assert ts[0] == 980 and ts[1] == 960 and ts[2] == 960 and ts[3] == 940
+
+
+def test_ddim_zero_eps_scales_by_alpha_ratio():
+    s = make_schedule(50)
+    x = jnp.ones((2, 4, 4, 1))
+    t = jnp.int32(980)
+    out = ddim_step(s, jnp.zeros_like(x), t, x)
+    a_t = s.alphas_cumprod[980]
+    a_prev = s.alphas_cumprod[960]
+    np.testing.assert_allclose(np.asarray(out), np.sqrt(a_prev / a_t), rtol=1e-5)
+
+
+def test_ddim_final_step_uses_final_alpha():
+    s = make_schedule(50, set_alpha_to_one=False)
+    x = jnp.full((1, 2, 2, 1), 0.7)
+    out = ddim_step(s, jnp.zeros_like(x), jnp.int32(0), x)
+    a_t = s.alphas_cumprod[0]
+    # prev_t = -20 < 0 -> final_alpha_cumprod = alphas_cumprod[0] = a_t
+    np.testing.assert_allclose(np.asarray(out), 0.7 * np.sqrt(a_t / a_t), rtol=1e-6)
+
+
+def test_ddim_matches_reference_closed_form():
+    """Independent transcription of /root/reference/null_text.py:471-489."""
+    s = make_schedule(50)
+    acp = np.asarray(s.alphas_cumprod, dtype=np.float64)
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 4, 4, 2).astype(np.float32)
+    eps = rng.randn(1, 4, 4, 2).astype(np.float32)
+    for t in [980, 500, 20]:
+        prev_t = t - 20
+        a_t, a_prev = acp[t], (acp[prev_t] if prev_t >= 0 else acp[0])
+        x0 = (x - (1 - a_t) ** 0.5 * eps) / a_t ** 0.5
+        want = a_prev ** 0.5 * x0 + (1 - a_prev) ** 0.5 * eps
+        got = ddim_step(s, jnp.asarray(eps), jnp.int32(t), jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=1e-5)
+        # next_step: timestep pair (t-20 -> t)
+        cur_t = min(t - 20, 999)
+        a_c = acp[cur_t] if cur_t >= 0 else acp[0]
+        a_n = acp[t]
+        x0n = (x - (1 - a_c) ** 0.5 * eps) / a_c ** 0.5
+        wantn = a_n ** 0.5 * x0n + (1 - a_n) ** 0.5 * eps
+        gotn = ddim_next_step(s, jnp.asarray(eps), jnp.int32(t), jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(gotn), wantn, rtol=2e-4, atol=1e-5)
+
+
+def test_ddim_inversion_roundtrip():
+    """next_step then prev_step with the same eps is identity (closed forms
+    are exact inverses when eps is held fixed)."""
+    s = make_schedule(50)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(1, 8, 8, 4).astype(np.float32))
+    eps = jnp.asarray(rng.randn(1, 8, 8, 4).astype(np.float32))
+    t = jnp.int32(500)
+    up = ddim_next_step(s, eps, t, x)
+    down = ddim_step(s, eps, t, up)
+    np.testing.assert_allclose(np.asarray(down), np.asarray(x), rtol=1e-3, atol=1e-4)
+
+
+def test_ddim_exact_noise_recovers_x0():
+    """If the model predicts the exact noise consistent with x_t, the DDIM
+    chain lands on x0 when set_alpha_to_one=True; with the SD setting
+    (False) it terminates at the t=0 noise level, sqrt(1-acp[0]) above x0."""
+    for alpha_to_one in (True, False):
+        s = make_schedule(50, set_alpha_to_one=alpha_to_one)
+        rng = np.random.RandomState(2)
+        x0 = jnp.asarray(rng.randn(1, 4, 4, 1).astype(np.float32))
+        noise = jnp.asarray(rng.randn(1, 4, 4, 1).astype(np.float32))
+        x = add_noise(s, x0, noise, jnp.int32(980))
+
+        def eps_of(x, t):
+            a = s.alphas_cumprod[t]
+            return (x - jnp.sqrt(a) * x0) / jnp.sqrt(1.0 - a)
+
+        for t in np.asarray(s.timesteps):
+            x = ddim_step(s, eps_of(x, int(t)), jnp.int32(int(t)), x)
+        if alpha_to_one:
+            np.testing.assert_allclose(np.asarray(x), np.asarray(x0), rtol=1e-2, atol=1e-3)
+        else:
+            a0 = np.asarray(s.alphas_cumprod[0])
+            want = np.sqrt(a0) * np.asarray(x0) + np.sqrt(1 - a0) * np.asarray(noise)
+            np.testing.assert_allclose(np.asarray(x), want, rtol=1e-2, atol=1e-3)
+
+
+class PlmsSimulator:
+    """List-based PLMS oracle following Liu et al. (arXiv 2202.09778) with the
+    warm-up re-evaluation, written independently of the scan implementation."""
+
+    def __init__(self, acp, step):
+        self.acp = acp
+        self.step = step
+        self.ets = []
+        self.counter = 0
+        self.cur_sample = None
+
+    def phi(self, x, t, prev_t, eps):
+        a_t = self.acp[t] if t >= 0 else self.acp[0]
+        a_p = self.acp[prev_t] if prev_t >= 0 else self.acp[0]
+        denom = a_t * (1 - a_p) ** 0.5 + (a_t * (1 - a_t) * a_p) ** 0.5
+        return (a_p / a_t) ** 0.5 * x - (a_p - a_t) * eps / denom
+
+    def __call__(self, eps, t, x):
+        prev_t = t - self.step
+        if self.counter != 1:
+            self.ets.append(eps)
+        else:
+            prev_t = t
+            t = t + self.step
+        if len(self.ets) == 1 and self.counter == 0:
+            used = eps
+            self.cur_sample = x
+        elif len(self.ets) == 1 and self.counter == 1:
+            used = (eps + self.ets[-1]) / 2
+            x = self.cur_sample
+        elif len(self.ets) == 2:
+            used = (3 * self.ets[-1] - self.ets[-2]) / 2
+        elif len(self.ets) == 3:
+            used = (23 * self.ets[-1] - 16 * self.ets[-2] + 5 * self.ets[-3]) / 12
+        else:
+            used = (55 * self.ets[-1] - 59 * self.ets[-2] + 37 * self.ets[-3]
+                    - 9 * self.ets[-4]) / 24
+        self.counter += 1
+        return self.phi(x, t, prev_t, used)
+
+
+def test_plms_matches_list_simulator():
+    T = 10
+    s = make_schedule(T, kind="plms")
+    acp = np.asarray(s.alphas_cumprod, dtype=np.float64)
+    rng = np.random.RandomState(3)
+    x0 = rng.randn(1, 4, 4, 1).astype(np.float64)
+
+    def model(x, t):
+        # a smooth, state-dependent fake ε so multistep history matters
+        return 0.3 * x + 0.01 * t / 1000.0
+
+    sim = PlmsSimulator(acp, s.step_size)
+    x_sim = x0.copy()
+    for t in np.asarray(s.timesteps):
+        x_sim = sim(model(x_sim, int(t)), int(t), x_sim)
+
+    state = init_plms_state(x0.shape)
+    x_jax = jnp.asarray(x0.astype(np.float32))
+    for t in np.asarray(s.timesteps):
+        eps = jnp.asarray(model(np.asarray(x_jax, dtype=np.float64), int(t)).astype(np.float32))
+        state, x_jax = plms_step(s, state, eps, jnp.int32(int(t)), x_jax)
+    np.testing.assert_allclose(np.asarray(x_jax), x_sim, rtol=5e-3, atol=1e-4)
+
+
+def test_plms_scan_compatible():
+    T = 5
+    s = make_schedule(T, kind="plms")
+    x0 = jnp.ones((1, 2, 2, 1))
+
+    def body(carry, t):
+        state, x = carry
+        eps = 0.1 * x
+        state, x = plms_step(s, state, eps, t, x)
+        return (state, x), None
+
+    (state, x), _ = jax.lax.scan(body, (init_plms_state(x0.shape), x0), s.timesteps)
+    assert np.isfinite(np.asarray(x)).all()
+    assert int(state.counter) == T + 1
+
+
+def test_ddpm_terminal_step_is_mean_only():
+    s = make_schedule(50)
+    x = jnp.ones((1, 2, 2, 1))
+    out1 = ddpm_step(s, jnp.zeros_like(x), jnp.int32(0), x, jax.random.PRNGKey(0))
+    out2 = ddpm_step(s, jnp.zeros_like(x), jnp.int32(0), x, jax.random.PRNGKey(1))
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-6)
+
+
+def test_add_noise_interpolates():
+    s = make_schedule(50)
+    x0 = jnp.ones((1, 2, 2, 1))
+    n = jnp.zeros_like(x0)
+    out = add_noise(s, x0, n, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(out), np.sqrt(np.asarray(s.alphas_cumprod[0])), rtol=1e-6)
