@@ -1,0 +1,78 @@
+"""Headline benchmark: 50-step SD-v1.4 512² AttentionReplace 2-prompt edit.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: ≥4 img/s/chip on TPU (driver north star, BASELINE.md). Weights are
+random-init (no checkpoint in the image) — throughput is weight-agnostic.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from p2p_tpu.controllers import factory
+    from p2p_tpu.engine.sampler import Pipeline, text2image
+    from p2p_tpu.models import SD14, TINY, init_text_encoder, init_unet
+    from p2p_tpu.models import vae as vae_mod
+    from p2p_tpu.utils.tokenizer import HashWordTokenizer
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", choices=("auto", "sd14", "tiny"), default="auto",
+                    help="auto: sd14 on an accelerator, tiny on CPU")
+    args = ap.parse_args()
+
+    platform = jax.devices()[0].platform
+    preset = args.preset
+    if preset == "auto":
+        preset = "sd14" if platform != "cpu" else "tiny"
+    on_accel = preset == "sd14"
+    cfg = SD14 if on_accel else TINY
+    num_steps = 50 if on_accel else 4
+    dtype = jnp.bfloat16 if on_accel else jnp.float32
+
+    tok = HashWordTokenizer(model_max_length=cfg.text.max_length)
+    pipe = Pipeline(
+        config=cfg,
+        unet_params=init_unet(jax.random.PRNGKey(0), cfg.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(1), cfg.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae),
+        tokenizer=tok,
+    )
+    prompts = ["a squirrel eating a burger", "a squirrel eating a lasagna"]
+    controller = factory.attention_replace(
+        prompts, num_steps, cross_replace_steps=0.8, self_replace_steps=0.4,
+        tokenizer=tok,
+        self_max_pixels=16 * 16 if on_accel else 8 * 8,
+        max_len=cfg.text.max_length)
+
+    def run(seed):
+        img, _, _ = text2image(pipe, prompts, controller, num_steps=num_steps,
+                               rng=jax.random.PRNGKey(seed), dtype=dtype)
+        jax.block_until_ready(img)
+        return img
+
+    run(0)  # compile
+    n_runs = 3
+    t0 = time.perf_counter()
+    for i in range(n_runs):
+        run(i + 1)
+    dt = time.perf_counter() - t0
+
+    imgs_per_s = n_runs * len(prompts) / dt
+    baseline = 4.0  # img/s/chip target (BASELINE.md north star)
+    print(json.dumps({
+        "metric": f"sd14_512_replace_edit_{num_steps}step_imgs_per_s"
+                  if on_accel else "tiny_cpu_fallback_imgs_per_s",
+        "value": round(imgs_per_s, 4),
+        "unit": "img/s/chip",
+        "vs_baseline": round(imgs_per_s / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -161,6 +161,28 @@ class Controller:
         return self.store or self.blend is not None
 
 
+def controller_touches(controller: Optional["Controller"], meta: AttnMeta) -> bool:
+    """Static (trace-time) predicate: does this controller ever read or write
+    this call site's attention probabilities?
+
+    Sites where this is False run fully fused attention — the probability
+    tensor never exists in the compiled program. This is the TPU answer to the
+    reference disabling xformers globally (`/root/reference/null_text.py:32-35`):
+    only the sites prompt-to-prompt provably touches (edited self maps ≤
+    ``self_max_pixels``, all cross maps under an edit, and stored slots —
+    `/root/reference/main.py:131,170`) pay for materialization.
+    """
+    if controller is None or controller.is_identity:
+        return False
+    if meta.store_slot is not None and controller.needs_store:
+        return True
+    if controller.edit is not None:
+        if meta.is_cross:
+            return True
+        return meta.pixels <= controller.edit.self_max_pixels
+    return False
+
+
 StoreState = Tuple[jax.Array, ...]
 
 

@@ -100,8 +100,13 @@ def attention_replace(
     local_blend: Optional[BlendParams] = None,
     self_max_pixels: int = 16 * 16,
     max_len: Optional[int] = None,
+    store: bool = True,
 ) -> Controller:
-    """Word-swap edit (`/root/reference/main.py:215-230`)."""
+    """Word-swap edit (`/root/reference/main.py:215-230`).
+
+    ``store=True`` mirrors the reference, whose edit controllers extend
+    AttentionStore and always accumulate ≤32²-pixel maps (`main.py:162`);
+    pass False to trade observability for store bandwidth."""
     L = max_len or tokenizer.model_max_length
     lo, hi = _self_window(num_steps, self_replace_steps)
     edit = EditParams(
@@ -112,7 +117,7 @@ def attention_replace(
         self_end=jnp.int32(hi),
         self_max_pixels=self_max_pixels,
     )
-    return Controller(edit=edit, blend=local_blend)
+    return Controller(edit=edit, blend=local_blend, store=store)
 
 
 def attention_refine(
@@ -124,6 +129,7 @@ def attention_refine(
     local_blend: Optional[BlendParams] = None,
     self_max_pixels: int = 16 * 16,
     max_len: Optional[int] = None,
+    store: bool = True,
 ) -> Controller:
     """Token-add edit via NW alignment (`/root/reference/main.py:233-253`)."""
     L = max_len or tokenizer.model_max_length
@@ -138,7 +144,7 @@ def attention_refine(
         self_end=jnp.int32(hi),
         self_max_pixels=self_max_pixels,
     )
-    return Controller(edit=edit, blend=local_blend)
+    return Controller(edit=edit, blend=local_blend, store=store)
 
 
 def attention_reweight(
@@ -152,6 +158,7 @@ def attention_reweight(
     base: Optional[Controller] = None,
     self_max_pixels: int = 16 * 16,
     max_len: Optional[int] = None,
+    store: bool = True,
 ) -> Controller:
     """Per-token attention rescaling, optionally stacked on a Replace/Refine
     controller (`/root/reference/main.py:256-278`): ``base``'s cross transform
@@ -182,7 +189,7 @@ def attention_reweight(
         self_end=jnp.int32(hi),
         self_max_pixels=self_max_pixels,
     )
-    return Controller(edit=edit, blend=local_blend)
+    return Controller(edit=edit, blend=local_blend, store=store)
 
 
 def make_controller(

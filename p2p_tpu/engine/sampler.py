@@ -1,0 +1,220 @@
+"""The sampling engine: jit-compiled text→image with attention control.
+
+Behavioral spec: `/root/reference/ptp_utils.py:65-172` (`diffusion_step`,
+`text2image_ldm_stable`, `init_latent`, `latent2image`). TPU re-design:
+
+- The T-step denoising loop is a single ``lax.scan`` whose carry is
+  ``(latents, controller store state, PLMS multistep state)`` — the step index
+  arrives from the scanned-over ``(step, timestep)`` pair, replacing the
+  reference's ``cur_step`` mutation.
+- CFG rides batch-doubling exactly as `/root/reference/ptp_utils.py:70-73`:
+  one U-Net call on ``[uncond; cond]`` of batch 2B. (The reference's
+  ``low_resource`` two-call variant is a GPU-memory workaround we don't need;
+  see `/root/reference/ptp_utils.py:66-68`.)
+- The controller is a pytree *argument* of the jitted function: edit
+  parameters, thresholds and step windows are traced leaves, so sweeping them
+  reuses one compiled program. Controller *structure* (kind, which sites are
+  touched) is static and changes the program — the identity controller
+  compiles to a plain sampler with zero hook overhead.
+- The shared-seed expansion of `/root/reference/ptp_utils.py:88-95` (all
+  prompts in an edit group start from ONE latent — essential to P2P) lives in
+  :func:`init_latent`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..controllers.base import (
+    AttnLayout,
+    Controller,
+    StoreState,
+    apply_step_callback,
+    init_store_state,
+)
+from ..models import vae as vae_mod
+from ..models.config import PipelineConfig
+from ..models.text_encoder import apply_text_encoder
+from ..models.unet import apply_unet
+from ..ops import schedulers as sched_mod
+from ..utils.tokenizer import Tokenizer, pad_ids
+
+
+@dataclasses.dataclass(frozen=True)
+class Pipeline:
+    """A bound backend: config + parameter pytrees. The analogue of the
+    reference's `StableDiffusionPipeline` handle (`/root/reference/main.py:29`),
+    but immutable — controllers are sampling-call arguments, never installed
+    into the model."""
+
+    config: PipelineConfig
+    unet_params: Any
+    text_params: Any
+    vae_params: Any
+    tokenizer: Tokenizer
+
+    @property
+    def latent_shape(self) -> Tuple[int, int, int]:
+        s = self.config.latent_size
+        return (s, s, self.config.unet.in_channels)
+
+
+@partial(jax.jit, static_argnames=("cfg", "dtype"))
+def _encode_jit(params, cfg, ids, dtype):
+    return apply_text_encoder(params, cfg, ids, dtype=dtype)
+
+
+def encode_prompts(pipe: Pipeline, prompts, dtype=jnp.float32) -> jax.Array:
+    """Tokenize + encode to (B, L, D) hidden states
+    (`/root/reference/ptp_utils.py:144-156`)."""
+    tok = pipe.tokenizer
+    max_len = pipe.config.unet.context_len
+    ids = jnp.asarray(
+        [pad_ids(tok.encode(p), max_len, getattr(tok, "pad_token_id", tok.eos_token_id))
+         for p in prompts], dtype=jnp.int32)
+    return _encode_jit(pipe.text_params, pipe.config.text, ids, dtype)
+
+
+def init_latent(latent: Optional[jax.Array], shape: Tuple[int, ...], rng: jax.Array,
+                batch: int, dtype=jnp.float32) -> Tuple[jax.Array, jax.Array]:
+    """One latent expanded over the edit group
+    (`/root/reference/ptp_utils.py:88-95`). Returns (single, batched)."""
+    if latent is None:
+        latent = jax.random.normal(rng, (1,) + tuple(shape), dtype=dtype)
+    latents = jnp.broadcast_to(latent, (batch,) + tuple(latent.shape[1:])).astype(dtype)
+    return latent, latents
+
+
+def _denoise_scan(
+    unet_params: Any,
+    cfg: PipelineConfig,
+    layout: AttnLayout,
+    schedule: sched_mod.DiffusionSchedule,
+    scheduler_kind: str,
+    context: jax.Array,            # (2B, L, D) [uncond; cond]
+    latents: jax.Array,            # (B, h, w, c)
+    controller: Optional[Controller],
+    guidance_scale: jax.Array,
+    uncond_per_step: Optional[jax.Array] = None,  # (T, 1, L, D) null-text embeddings
+) -> Tuple[jax.Array, StoreState]:
+    """Scan over timesteps. Returns (final latents, final store state)."""
+    b = latents.shape[0]
+    state = (init_store_state(layout, b, dtype=jnp.float32)
+             if (controller is not None and controller.needs_store) else ())
+
+    use_plms = scheduler_kind == "plms"
+    plms = (sched_mod.init_plms_state(latents.shape, latents.dtype) if use_plms
+            else None)
+
+    def body(carry, scan_in):
+        latents, state, plms = carry
+        step, t = scan_in
+        ctx = context
+        if uncond_per_step is not None:
+            # Null-text: substitute this step's optimized uncond embedding.
+            u = jax.lax.dynamic_index_in_dim(uncond_per_step, step, 0, keepdims=False)
+            ctx = jnp.concatenate([jnp.broadcast_to(u, context[:b].shape),
+                                   context[b:]], axis=0)
+        latent_in = jnp.concatenate([latents] * 2, axis=0)
+        eps, state = apply_unet(
+            unet_params, cfg.unet, latent_in, t, ctx,
+            layout=layout, controller=controller, state=state, step=step)
+        eps_uncond, eps_text = eps[:b], eps[b:]
+        eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
+        if use_plms:
+            plms, latents = sched_mod.plms_step(schedule, plms, eps, t, latents)
+        else:
+            latents = sched_mod.ddim_step(schedule, eps, t, latents)
+        latents = apply_step_callback(controller, layout, state, latents, step)
+        return (latents, state, plms), None
+
+    steps = jnp.arange(schedule.timesteps.shape[0], dtype=jnp.int32)
+    (latents, state, _), _ = jax.lax.scan(
+        body, (latents, state, plms), (steps, schedule.timesteps))
+    return latents, state
+
+
+@partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
+                                   "return_store"))
+def _text2image_jit(
+    unet_params: Any,
+    vae_params: Any,
+    cfg: PipelineConfig,
+    layout: AttnLayout,
+    schedule: sched_mod.DiffusionSchedule,
+    scheduler_kind: str,
+    context_cond: jax.Array,
+    context_uncond: jax.Array,
+    latents: jax.Array,
+    controller: Optional[Controller],
+    guidance_scale: jax.Array,
+    uncond_per_step: Optional[jax.Array],
+    return_store: bool,
+):
+    context = jnp.concatenate([context_uncond, context_cond], axis=0)
+    latents, state = _denoise_scan(
+        unet_params, cfg, layout, schedule, scheduler_kind, context, latents,
+        controller, guidance_scale, uncond_per_step)
+    image = vae_mod.decode(vae_params, cfg.vae, latents.astype(jnp.float32))
+    image = vae_mod.to_uint8(image)
+    return (image, latents, state) if return_store else (image, latents, ())
+
+
+def text2image(
+    pipe: Pipeline,
+    prompts,
+    controller: Optional[Controller] = None,
+    *,
+    num_steps: Optional[int] = None,
+    guidance_scale: Optional[float] = None,
+    scheduler: str = "ddim",
+    latent: Optional[jax.Array] = None,
+    rng: Optional[jax.Array] = None,
+    uncond_embeddings: Optional[jax.Array] = None,
+    layout: Optional[AttnLayout] = None,
+    dtype=jnp.float32,
+    return_store: bool = False,
+):
+    """Generate an edit group of images from prompts under attention control —
+    the `/root/reference/ptp_utils.py:129-172` entry point.
+
+    ``uncond_embeddings``: optional (T, 1, L, D) per-step null-text
+    embeddings; otherwise the encoded ``""`` is broadcast over all steps.
+    Returns ``(images uint8 (B,H,W,3), x_T, store_state)``.
+    """
+    cfg = pipe.config
+    num_steps = num_steps or cfg.num_steps
+    if uncond_embeddings is not None:
+        if scheduler != "ddim":
+            # PLMS scans T+1 steps (warm-up double-evaluation); per-step
+            # null-text embeddings are optimized against the DDIM trajectory
+            # and would silently misalign (`/root/reference/null_text.py:23`
+            # — the null-text path is DDIM-only).
+            raise ValueError("uncond_embeddings require scheduler='ddim'")
+        if uncond_embeddings.shape[0] != num_steps:
+            raise ValueError(
+                f"uncond_embeddings has {uncond_embeddings.shape[0]} steps, "
+                f"sampling uses {num_steps}")
+    gs = jnp.asarray(cfg.guidance_scale if guidance_scale is None else guidance_scale,
+                     dtype=jnp.float32)
+    if layout is None:
+        from ..models.config import unet_layout
+        layout = unet_layout(cfg.unet)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    schedule = sched_mod.make_schedule(num_steps, kind=scheduler)
+    context_cond = encode_prompts(pipe, prompts, dtype=dtype)
+    context_uncond = encode_prompts(pipe, [""] * len(prompts), dtype=dtype)
+
+    x_t, latents = init_latent(latent, pipe.latent_shape, rng, len(prompts), dtype)
+    image, latents_out, state = _text2image_jit(
+        pipe.unet_params, pipe.vae_params, cfg, layout, schedule, scheduler,
+        context_cond, context_uncond, latents, controller, gs,
+        uncond_embeddings, return_store)
+    return image, x_t, state

@@ -1,0 +1,32 @@
+"""Model stack: config-driven text encoder, conditional U-Net, and VAE.
+
+TPU-first re-design of the model surface the reference borrows from
+diffusers 0.8.1 (`/root/reference/requirements.txt:1`): pure-functional
+modules over explicit param pytrees, NHWC layouts, static attention layouts
+derived from config (no runtime monkey-patching), fused attention everywhere
+the prompt-to-prompt controller provably never looks.
+"""
+
+from .config import (
+    LDM256,
+    SD14,
+    TINY,
+    PipelineConfig,
+    TextEncoderConfig,
+    UNetConfig,
+    VAEConfig,
+    unet_attn_specs,
+    unet_layout,
+)
+from .text_encoder import apply_text_encoder, init_text_encoder
+from .unet import apply_unet, init_unet
+from . import vae
+
+__all__ = [
+    "LDM256", "SD14", "TINY",
+    "PipelineConfig", "TextEncoderConfig", "UNetConfig", "VAEConfig",
+    "unet_attn_specs", "unet_layout",
+    "apply_text_encoder", "init_text_encoder",
+    "apply_unet", "init_unet",
+    "vae",
+]

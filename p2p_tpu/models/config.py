@@ -1,0 +1,189 @@
+"""Model configurations and static attention-layout derivation.
+
+The reference discovers its attention structure by walking the live U-Net and
+counting hooked modules at registration time (`/root/reference/ptp_utils.py:223-242`).
+Here the structure is a pure function of the config: :func:`unet_attn_specs`
+enumerates every attention call site (place, kind, resolution, heads, key
+length) in exact call order, and feeds `controllers.base.build_layout` — so
+layer bookkeeping is settled before tracing and costs nothing at runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from ..controllers.base import AttnLayout, StoreConfig, build_layout
+
+
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    """Shape config for the conditional U-Net (diffusers
+    `UNet2DConditionModel` topology, e.g. SD-v1.4's 32 attention sites)."""
+
+    sample_size: int = 64                  # latent side length
+    in_channels: int = 4
+    out_channels: int = 4
+    block_channels: Tuple[int, ...] = (320, 640, 1280, 1280)
+    # True → the down/up block at this level carries transformer blocks.
+    attn_levels: Tuple[bool, ...] = (True, True, True, False)
+    layers_per_block: int = 2
+    num_heads: int = 8
+    context_dim: int = 768                 # text-encoder hidden size
+    context_len: int = 77
+    transformer_depth: int = 1             # transformer blocks per attn site group
+    groups: int = 32
+    ff_mult: int = 4
+    freq_dim: Optional[int] = None         # sinusoidal dim; default block_channels[0]
+
+    @property
+    def time_embed_dim(self) -> int:
+        return self.block_channels[0] * 4
+
+    @property
+    def levels(self) -> int:
+        return len(self.block_channels)
+
+    def resolution_at(self, level: int) -> int:
+        return self.sample_size >> level
+
+
+SD14_UNET = UNetConfig()
+
+# Tiny config for tests: same topology class (2 of 3 levels attentive, mid
+# attention, skip concats, CFG) at ~1/4000 the parameters. Latent 16² keeps a
+# 16²→8²→4² pyramid so store/blend resolutions exist.
+TINY_UNET = UNetConfig(
+    sample_size=16,
+    in_channels=4,
+    out_channels=4,
+    block_channels=(32, 64, 64),
+    attn_levels=(True, True, False),
+    layers_per_block=1,
+    num_heads=2,
+    context_dim=32,
+    context_len=16,
+    groups=8,
+    ff_mult=2,
+)
+
+
+def unet_attn_specs(cfg: UNetConfig):
+    """Every attention call site in forward-call order, as
+    ``(place, is_cross, resolution, heads, key_len)`` tuples.
+
+    Order contract (must match ``unet.apply_unet``'s call order): down blocks
+    (per transformer block: self then cross), mid, up blocks. For SD14_UNET
+    this yields exactly the reference's 32 hooked sites with the store slice
+    ``down_cross[2:4] + up_cross[:3]`` landing on the 16×16 cross maps
+    (`/root/reference/main.py:37-38`)."""
+    specs = []
+
+    def site(place, level):
+        res = cfg.resolution_at(level)
+        heads = cfg.num_heads
+        for _ in range(cfg.transformer_depth):
+            specs.append((place, False, res, heads, res * res))       # self
+            specs.append((place, True, res, heads, cfg.context_len))  # cross
+
+    for level in range(cfg.levels):                      # down
+        if cfg.attn_levels[level]:
+            for _ in range(cfg.layers_per_block):
+                site("down", level)
+    site("mid", cfg.levels - 1)                          # mid
+    for level in reversed(range(cfg.levels)):            # up
+        if cfg.attn_levels[level]:
+            for _ in range(cfg.layers_per_block + 1):
+                site("up", level)
+    return specs
+
+
+def unet_layout(cfg: UNetConfig, store_cfg: Optional[StoreConfig] = None
+                ) -> AttnLayout:
+    if store_cfg is None:
+        # The reference stores every ≤32²-pixel map (`/root/reference/main.py:131`);
+        # scale that bound with the latent size so tiny test models store their
+        # two lower pyramid levels the same way SD stores 32²/16²/8².
+        store_cfg = StoreConfig(max_pixels=(cfg.sample_size // 2) ** 2)
+    return build_layout(unet_attn_specs(cfg), store_cfg)
+
+
+@dataclasses.dataclass(frozen=True)
+class TextEncoderConfig:
+    """CLIP-style causal text transformer (SD-1.4: ViT-L/14 text tower)."""
+
+    vocab_size: int = 49408
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_length: int = 77
+    ff_mult: int = 4
+    activation: str = "quick_gelu"         # CLIP-L uses quick_gelu
+    causal: bool = True
+
+SD14_TEXT = TextEncoderConfig()
+TINY_TEXT = TextEncoderConfig(vocab_size=49408, hidden_dim=32, num_layers=2,
+                              num_heads=2, max_length=16)
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    """KL autoencoder (diffusers `AutoencoderKL` topology)."""
+
+    in_channels: int = 3
+    latent_channels: int = 4
+    base_channels: int = 128
+    channel_mults: Tuple[int, ...] = (1, 2, 4, 4)
+    layers_per_block: int = 2
+    groups: int = 32
+    scaling_factor: float = 0.18215        # `/root/reference/ptp_utils.py:80`
+
+SD14_VAE = VAEConfig()
+TINY_VAE = VAEConfig(base_channels=16, channel_mults=(1, 2, 2), layers_per_block=1,
+                     groups=8)  # 2 downsamples: 64² image ⇄ 16² latent
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """A full backend: text encoder + U-Net + VAE + scheduler defaults."""
+
+    name: str
+    unet: UNetConfig
+    text: TextEncoderConfig
+    vae: VAEConfig
+    image_size: int = 512
+    guidance_scale: float = 7.5            # `/root/reference/main.py:20`
+    num_steps: int = 50
+
+    @property
+    def latent_size(self) -> int:
+        return self.unet.sample_size
+
+
+SD14 = PipelineConfig("sd-v1.4", SD14_UNET, SD14_TEXT, SD14_VAE, image_size=512)
+TINY = PipelineConfig("tiny", TINY_UNET, TINY_TEXT, TINY_VAE, image_size=64,
+                      num_steps=4)
+
+# LDM text2im-large-256 (`/root/reference/ptp_utils.py:98-126`): BERT-style
+# (non-causal, gelu) 1280-d text encoder, 32² latent pyramid, VQ decoder
+# handled by the VAE stack with its own scaling. Attention heads: LDM uses
+# fixed head_dim 64 → heads vary per level; we keep uniform heads (a config
+# simplification that preserves shapes' head*dim products).
+LDM_UNET = UNetConfig(
+    sample_size=32,
+    in_channels=4,
+    out_channels=4,
+    block_channels=(320, 640, 1280),
+    attn_levels=(True, True, True),
+    layers_per_block=2,
+    num_heads=8,
+    context_dim=1280,
+    context_len=77,
+)
+LDM_TEXT = TextEncoderConfig(vocab_size=30522, hidden_dim=1280, num_layers=32,
+                             num_heads=8, max_length=77, activation="gelu",
+                             causal=False)
+LDM_VAE = VAEConfig(base_channels=128, channel_mults=(1, 2, 4), latent_channels=4,
+                    scaling_factor=1.0)
+LDM256 = PipelineConfig("ldm-text2im-256", LDM_UNET, LDM_TEXT, LDM_VAE,
+                        image_size=256, guidance_scale=5.0, num_steps=50)

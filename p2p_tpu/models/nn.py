@@ -1,0 +1,174 @@
+"""Minimal functional NN toolkit: explicit param pytrees + pure apply fns.
+
+Why not flax.linen: the prompt-to-prompt hook must thread controller store
+state through every attention call site *in call order* and return it from the
+model forward. With explicit (params, x, state) -> (y, state) functions that
+threading is plain dataflow, the param tree maps 1:1 onto checkpoint names,
+and everything is trivially jit/pjit/scan-compatible. All spatial tensors are
+NHWC (TPU-native layout); compute dtype is a caller choice (bf16 on TPU),
+while normalization statistics and softmax run in f32.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def _split(key, n):
+    return jax.random.split(key, n)
+
+
+# ---------------------------------------------------------------------------
+# Linear / Conv
+# ---------------------------------------------------------------------------
+
+
+def linear_init(key, in_dim: int, out_dim: int, bias: bool = True,
+                dtype=jnp.float32) -> Params:
+    kk, _ = _split(key, 2)
+    scale = 1.0 / math.sqrt(in_dim)
+    p = {"kernel": jax.random.uniform(kk, (in_dim, out_dim), dtype, -scale, scale)}
+    if bias:
+        p["bias"] = jnp.zeros((out_dim,), dtype)
+    return p
+
+
+def linear(p: Params, x: jax.Array) -> jax.Array:
+    y = x @ p["kernel"].astype(x.dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    return y
+
+
+def conv_init(key, in_ch: int, out_ch: int, kernel: int = 3, bias: bool = True,
+              dtype=jnp.float32) -> Params:
+    kk, _ = _split(key, 2)
+    fan_in = in_ch * kernel * kernel
+    scale = 1.0 / math.sqrt(fan_in)
+    p = {"kernel": jax.random.uniform(kk, (kernel, kernel, in_ch, out_ch), dtype,
+                                      -scale, scale)}
+    if bias:
+        p["bias"] = jnp.zeros((out_ch,), dtype)
+    return p
+
+
+def conv2d(p: Params, x: jax.Array, stride: int = 1, padding: str | int = "SAME"
+           ) -> jax.Array:
+    """NHWC conv; weight layout HWIO."""
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    y = jax.lax.conv_general_dilated(
+        x, p["kernel"].astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if "bias" in p:
+        y = y + p["bias"].astype(x.dtype)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Norms (statistics in f32 regardless of compute dtype)
+# ---------------------------------------------------------------------------
+
+
+def norm_init(dim: int, dtype=jnp.float32) -> Params:
+    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+
+
+def group_norm(p: Params, x: jax.Array, groups: int = 32, eps: float = 1e-5
+               ) -> jax.Array:
+    """GroupNorm over an NHWC (or N...C) tensor."""
+    orig_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    c = x.shape[-1]
+    g = min(groups, c)
+    xg = x.reshape(x.shape[:-1] + (g, c // g))
+    red = tuple(range(1, xg.ndim - 2)) + (xg.ndim - 1,)
+    mean = xg.mean(axis=red, keepdims=True)
+    var = xg.var(axis=red, keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + eps)
+    x = xg.reshape(x.shape)
+    return (x * p["scale"] + p["bias"]).astype(orig_dtype)
+
+
+def layer_norm(p: Params, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    orig_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(orig_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Activations / embeddings
+# ---------------------------------------------------------------------------
+
+
+def silu(x):
+    return x * jax.nn.sigmoid(x)
+
+
+def gelu(x):
+    return jax.nn.gelu(x, approximate=False)
+
+
+def quick_gelu(x):
+    """CLIP's activation: x * sigmoid(1.702 x)."""
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10000.0,
+                       dtype=jnp.float32) -> jax.Array:
+    """Sinusoidal timestep embedding, diffusers `Timesteps` semantics
+    (flip_sin_to_cos=True, downscale_freq_shift=0): [cos | sin] halves."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[..., None] * freqs
+    emb = jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    if dim % 2:
+        emb = jnp.pad(emb, [(0, 0)] * (emb.ndim - 1) + [(0, 1)])
+    return emb.astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Attention core
+# ---------------------------------------------------------------------------
+
+
+def attention_probs(q: jax.Array, k: jax.Array, scale: float,
+                    mask: Optional[jax.Array] = None) -> jax.Array:
+    """Materialized softmax(QKᵀ·scale) in f32 — the tensor prompt-to-prompt
+    edits (`/root/reference/ptp_utils.py:195-205`). q,k: (B, heads, S, D)."""
+    sim = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                     preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        sim = sim + mask
+    return jax.nn.softmax(sim.astype(jnp.float32), axis=-1)
+
+
+def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array, scale: float,
+                    mask: Optional[jax.Array] = None) -> jax.Array:
+    """Attention for call sites the controller provably never reads
+    (`/root/reference/main.py:131,170` never touches 64²-pixel maps).
+
+    Routed through `jax.nn.dot_product_attention` so XLA may lower to a
+    flash/blockwise kernel that never materializes the (S, S) probability
+    tensor — an explicit softmax-between-einsums chain would always
+    materialize it. q,k,v: (B, heads, S, D); mask: additive, broadcastable
+    to (B, heads, Sq, Sk)."""
+    bias = None
+    if mask is not None:
+        bias = mask.astype(q.dtype)
+    out = jax.nn.dot_product_attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+        bias=bias, scale=scale)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,313 @@
+"""Conditional U-Net with attention-processor injection — the denoising model.
+
+Topology matches diffusers' `UNet2DConditionModel` as configured for SD-v1.4
+(the model the reference drives, `/root/reference/main.py:29`): conv_in →
+attentive down blocks → mid → attentive up blocks with skip concats → conv_out,
+where every transformer block holds a self- and a cross-attention site.
+
+The prompt-to-prompt integration point is designed in, not monkey-patched
+(`/root/reference/ptp_utils.py:175-242` is the behavior spec): every attention
+site has a static :class:`AttnMeta`, and :func:`apply_unet` threads the
+controller's store state through the sites in call order. Sites the controller
+provably never touches (``controller_touches`` is False) run fused attention —
+no probability tensor exists in the compiled program; touched sites
+materialize f32 probabilities, route them through
+``apply_attention_control``, then finish ``probs @ v``.
+
+All tensors NHWC; params f32; compute dtype is the caller's (`x.dtype`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..controllers.base import (
+    AttnLayout,
+    Controller,
+    StoreState,
+    apply_attention_control,
+    controller_touches,
+)
+from .config import UNetConfig, unet_layout
+from . import nn
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def _attn_init(key, query_dim: int, context_dim: int, inner_dim: int) -> Params:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "to_q": nn.linear_init(k1, query_dim, inner_dim, bias=False),
+        "to_k": nn.linear_init(k2, context_dim, inner_dim, bias=False),
+        "to_v": nn.linear_init(k3, context_dim, inner_dim, bias=False),
+        "to_out": nn.linear_init(k4, inner_dim, query_dim),
+    }
+
+
+def _transformer_block_init(key, dim: int, context_dim: int, ff_mult: int) -> Params:
+    k1, k2, k3 = jax.random.split(key, 3)
+    ff_inner = dim * ff_mult
+    return {
+        "ln1": nn.norm_init(dim),
+        "attn1": _attn_init(k1, dim, dim, dim),
+        "ln2": nn.norm_init(dim),
+        "attn2": _attn_init(k2, dim, context_dim, dim),
+        "ln3": nn.norm_init(dim),
+        # GEGLU: one projection to 2·ff_inner (value ‖ gate), then back.
+        "ff_in": nn.linear_init(jax.random.split(k3)[0], dim, ff_inner * 2),
+        "ff_out": nn.linear_init(jax.random.split(k3)[1], ff_inner, dim),
+    }
+
+
+def _spatial_transformer_init(key, ch: int, cfg: UNetConfig) -> Params:
+    keys = jax.random.split(key, cfg.transformer_depth + 2)
+    return {
+        "norm": nn.norm_init(ch),
+        "proj_in": nn.conv_init(keys[0], ch, ch, kernel=1),
+        "blocks": [
+            _transformer_block_init(keys[1 + i], ch, cfg.context_dim, cfg.ff_mult)
+            for i in range(cfg.transformer_depth)
+        ],
+        "proj_out": nn.conv_init(keys[-1], ch, ch, kernel=1),
+    }
+
+
+def _resnet_init(key, in_ch: int, out_ch: int, temb_dim: int) -> Params:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    p = {
+        "norm1": nn.norm_init(in_ch),
+        "conv1": nn.conv_init(k1, in_ch, out_ch),
+        "time_proj": nn.linear_init(k2, temb_dim, out_ch),
+        "norm2": nn.norm_init(out_ch),
+        "conv2": nn.conv_init(k3, out_ch, out_ch),
+    }
+    if in_ch != out_ch:
+        p["skip"] = nn.conv_init(k4, in_ch, out_ch, kernel=1)
+    return p
+
+
+def init_unet(key: jax.Array, cfg: UNetConfig) -> Params:
+    """Random-init parameter pytree with SD-faithful shapes."""
+    n_levels = cfg.levels
+    keys = iter(jax.random.split(key, 64))
+    ch0 = cfg.block_channels[0]
+    temb = cfg.time_embed_dim
+
+    params: Params = {
+        "time_fc1": nn.linear_init(next(keys), cfg.freq_dim or ch0, temb),
+        "time_fc2": nn.linear_init(next(keys), temb, temb),
+        "conv_in": nn.conv_init(next(keys), cfg.in_channels, ch0),
+        "down": [],
+        "up": [],
+        "norm_out": nn.norm_init(ch0),
+        "conv_out": nn.conv_init(next(keys), ch0, cfg.out_channels),
+    }
+
+    # Down path. Skip-channel bookkeeping mirrors diffusers exactly so up-block
+    # concat widths match real checkpoints.
+    skip_chs = [ch0]
+    in_ch = ch0
+    for level in range(n_levels):
+        out_ch = cfg.block_channels[level]
+        block: Params = {"resnets": [], "attns": []}
+        for _ in range(cfg.layers_per_block):
+            block["resnets"].append(_resnet_init(next(keys), in_ch, out_ch, temb))
+            if cfg.attn_levels[level]:
+                block["attns"].append(_spatial_transformer_init(next(keys), out_ch, cfg))
+            in_ch = out_ch
+            skip_chs.append(out_ch)
+        if level != n_levels - 1:
+            block["downsample"] = nn.conv_init(next(keys), out_ch, out_ch)
+            skip_chs.append(out_ch)
+        params["down"].append(block)
+
+    mid_ch = cfg.block_channels[-1]
+    params["mid"] = {
+        "resnet1": _resnet_init(next(keys), mid_ch, mid_ch, temb),
+        "attn": _spatial_transformer_init(next(keys), mid_ch, cfg),
+        "resnet2": _resnet_init(next(keys), mid_ch, mid_ch, temb),
+    }
+
+    # Up path (reverse level order).
+    in_ch = mid_ch
+    for level in reversed(range(n_levels)):
+        out_ch = cfg.block_channels[level]
+        block = {"resnets": [], "attns": []}
+        for _ in range(cfg.layers_per_block + 1):
+            skip_ch = skip_chs.pop()
+            block["resnets"].append(
+                _resnet_init(next(keys), in_ch + skip_ch, out_ch, temb))
+            if cfg.attn_levels[level]:
+                block["attns"].append(_spatial_transformer_init(next(keys), out_ch, cfg))
+            in_ch = out_ch
+        if level != 0:
+            block["upsample"] = nn.conv_init(next(keys), out_ch, out_ch)
+        params["up"].append(block)
+
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Apply
+# ---------------------------------------------------------------------------
+
+
+def _apply_resnet(p: Params, x: jax.Array, temb: jax.Array, groups: int) -> jax.Array:
+    h = nn.conv2d(p["conv1"], nn.silu(nn.group_norm(p["norm1"], x, groups)))
+    h = h + nn.linear(p["time_proj"], nn.silu(temb))[:, None, None, :]
+    h = nn.conv2d(p["conv2"], nn.silu(nn.group_norm(p["norm2"], h, groups)))
+    if "skip" in p:
+        x = nn.conv2d(p["skip"], x)
+    return x + h
+
+
+class _HookCtx:
+    """Trace-time cursor over the attention layout, carrying the controller
+    store state through the sites in call order."""
+
+    def __init__(self, layout: AttnLayout, controller: Optional[Controller],
+                 state: StoreState, step: jax.Array):
+        self.layout = layout
+        self.controller = controller
+        self.state = state
+        self.step = step
+        self.cursor = 0
+
+    def next_meta(self):
+        meta = self.layout.metas[self.cursor]
+        self.cursor += 1
+        return meta
+
+
+def _apply_attention(p: Params, x: jax.Array, context: jax.Array, heads: int,
+                     ctx: _HookCtx, is_cross: bool) -> jax.Array:
+    """One attention site. x: (B, P, C); context: (B, K, Cc)."""
+    meta = ctx.next_meta()
+    assert meta.is_cross == is_cross, (
+        f"layout order mismatch at site {meta.layer_idx}: layout says "
+        f"is_cross={meta.is_cross}, model called is_cross={is_cross}")
+
+    b, pix, _ = x.shape
+    src = context if is_cross else x
+    q = nn.linear(p["to_q"], x)
+    k = nn.linear(p["to_k"], src)
+    v = nn.linear(p["to_v"], src)
+    d_head = q.shape[-1] // heads
+    scale = d_head ** -0.5
+
+    def split_heads(t):
+        return t.reshape(b, t.shape[1], heads, d_head).transpose(0, 2, 1, 3)
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+
+    if controller_touches(ctx.controller, meta):
+        probs = nn.attention_probs(q, k, scale)            # (B, heads, P, K) f32
+        ctx.state, probs = apply_attention_control(
+            ctx.controller, meta, ctx.state, probs, ctx.step)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+    else:
+        out = nn.fused_attention(q, k, v, scale)
+
+    out = out.transpose(0, 2, 1, 3).reshape(b, pix, heads * d_head)
+    return nn.linear(p["to_out"], out)
+
+
+def _apply_transformer_block(p: Params, x: jax.Array, context: jax.Array,
+                             heads: int, ctx: _HookCtx) -> jax.Array:
+    x = x + _apply_attention(p["attn1"], nn.layer_norm(p["ln1"], x), context,
+                             heads, ctx, is_cross=False)
+    x = x + _apply_attention(p["attn2"], nn.layer_norm(p["ln2"], x), context,
+                             heads, ctx, is_cross=True)
+    h = nn.linear(p["ff_in"], nn.layer_norm(p["ln3"], x))
+    val, gate = jnp.split(h, 2, axis=-1)
+    x = x + nn.linear(p["ff_out"], val * nn.gelu(gate))
+    return x
+
+
+def _apply_spatial_transformer(p: Params, x: jax.Array, context: jax.Array,
+                               cfg: UNetConfig, ctx: _HookCtx) -> jax.Array:
+    b, h, w, c = x.shape
+    residual = x
+    x = nn.group_norm(p["norm"], x, cfg.groups, eps=1e-6)
+    x = nn.conv2d(p["proj_in"], x)
+    x = x.reshape(b, h * w, c)
+    for block in p["blocks"]:
+        x = _apply_transformer_block(block, x, context, cfg.num_heads, ctx)
+    x = x.reshape(b, h, w, c)
+    x = nn.conv2d(p["proj_out"], x)
+    return x + residual
+
+
+def apply_unet(
+    params: Params,
+    cfg: UNetConfig,
+    x: jax.Array,                  # (B, H, W, C) latents, NHWC
+    t: jax.Array,                  # scalar or (B,) timestep
+    context: jax.Array,            # (B, K, Cc) text embeddings
+    layout: Optional[AttnLayout] = None,
+    controller: Optional[Controller] = None,
+    state: StoreState = (),
+    step: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, StoreState]:
+    """Predict ε(x_t, t, context). Returns ``(eps, controller_store_state)``.
+
+    With ``controller=None`` this is a plain conditional U-Net forward and the
+    returned state is the input state — the `EmptyControl ≡ no controller`
+    equivalence holds at the XLA-program level.
+    """
+    if layout is None:
+        layout = unet_layout(cfg)
+    if step is None:
+        step = jnp.int32(0)
+    ctx = _HookCtx(layout, controller, state, step)
+    g = cfg.groups
+
+    t = jnp.broadcast_to(jnp.asarray(t), (x.shape[0],))
+    temb = nn.timestep_embedding(t, cfg.freq_dim or cfg.block_channels[0],
+                                 dtype=x.dtype)
+    temb = nn.linear(params["time_fc2"], nn.silu(nn.linear(params["time_fc1"], temb)))
+
+    h = nn.conv2d(params["conv_in"], x)
+    skips = [h]
+    for level, block in enumerate(params["down"]):
+        for i, resnet in enumerate(block["resnets"]):
+            h = _apply_resnet(resnet, h, temb, g)
+            if block["attns"]:
+                h = _apply_spatial_transformer(block["attns"][i], h, context, cfg, ctx)
+            skips.append(h)
+        if "downsample" in block:
+            # Symmetric pad 1 (diffusers downsample_padding=1) — XLA SAME would
+            # pad (0,1) on even inputs and shift every downstream feature map.
+            h = nn.conv2d(block["downsample"], h, stride=2, padding=1)
+            skips.append(h)
+
+    h = _apply_resnet(params["mid"]["resnet1"], h, temb, g)
+    h = _apply_spatial_transformer(params["mid"]["attn"], h, context, cfg, ctx)
+    h = _apply_resnet(params["mid"]["resnet2"], h, temb, g)
+
+    for block in params["up"]:
+        for i, resnet in enumerate(block["resnets"]):
+            h = jnp.concatenate([h, skips.pop()], axis=-1)
+            h = _apply_resnet(resnet, h, temb, g)
+            if block["attns"]:
+                h = _apply_spatial_transformer(block["attns"][i], h, context, cfg, ctx)
+        if "upsample" in block:
+            b_, hh, ww, cc = h.shape
+            h = jax.image.resize(h, (b_, hh * 2, ww * 2, cc), method="nearest")
+            h = nn.conv2d(block["upsample"], h)
+
+    assert ctx.cursor == len(layout.metas), (
+        f"attention layout mismatch: model has {ctx.cursor} sites, "
+        f"layout has {len(layout.metas)}")
+
+    h = nn.silu(nn.group_norm(params["norm_out"], h, g))
+    eps = nn.conv2d(params["conv_out"], h)
+    return eps, ctx.state

@@ -1,0 +1,173 @@
+"""KL autoencoder (image ⇄ latent codecs), diffusers `AutoencoderKL` topology.
+
+The reference touches the VAE at three points, which are the API here:
+encode to the posterior **mean** scaled by 0.18215
+(`/root/reference/null_text.py:519-531` — it uses ``latent_dist.mean``, not a
+sample, for inversion), decode with the inverse scale
+(`/root/reference/ptp_utils.py:79-85`), and the uint8 image conversion
+``(x/2+.5).clamp(0,1)·255``. All NHWC.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import VAEConfig
+from . import nn
+
+Params = Dict[str, Any]
+
+
+def _resnet_init(key, in_ch, out_ch):
+    k1, k2, k3 = jax.random.split(key, 3)
+    p = {
+        "norm1": nn.norm_init(in_ch),
+        "conv1": nn.conv_init(k1, in_ch, out_ch),
+        "norm2": nn.norm_init(out_ch),
+        "conv2": nn.conv_init(k2, out_ch, out_ch),
+    }
+    if in_ch != out_ch:
+        p["skip"] = nn.conv_init(k3, in_ch, out_ch, kernel=1)
+    return p
+
+
+def _apply_resnet(p, x, groups):
+    h = nn.conv2d(p["conv1"], nn.silu(nn.group_norm(p["norm1"], x, groups)))
+    h = nn.conv2d(p["conv2"], nn.silu(nn.group_norm(p["norm2"], h, groups)))
+    if "skip" in p:
+        x = nn.conv2d(p["skip"], x)
+    return x + h
+
+
+def _attn_init(key, ch):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "norm": nn.norm_init(ch),
+        "q": nn.linear_init(k1, ch, ch),
+        "k": nn.linear_init(k2, ch, ch),
+        "v": nn.linear_init(k3, ch, ch),
+        "out": nn.linear_init(k4, ch, ch),
+    }
+
+
+def _apply_attn(p, x, groups):
+    """Single-head full self-attention over pixels (VAE mid block)."""
+    b, h, w, c = x.shape
+    residual = x
+    y = nn.group_norm(p["norm"], x, groups).reshape(b, h * w, c)
+    q = nn.linear(p["q"], y)[:, None]
+    k = nn.linear(p["k"], y)[:, None]
+    v = nn.linear(p["v"], y)[:, None]
+    out = nn.fused_attention(q, k, v, c ** -0.5)[:, 0]
+    out = nn.linear(p["out"], out).reshape(b, h, w, c)
+    return residual + out
+
+
+def init_vae(key: jax.Array, cfg: VAEConfig) -> Params:
+    keys = iter(jax.random.split(key, 64))
+    chs = [cfg.base_channels * m for m in cfg.channel_mults]
+    top = chs[-1]
+    lat = cfg.latent_channels
+
+    enc: Params = {"conv_in": nn.conv_init(next(keys), cfg.in_channels, chs[0]),
+                   "down": []}
+    in_ch = chs[0]
+    for level, out_ch in enumerate(chs):
+        block = {"resnets": []}
+        for _ in range(cfg.layers_per_block):
+            block["resnets"].append(_resnet_init(next(keys), in_ch, out_ch))
+            in_ch = out_ch
+        if level != len(chs) - 1:
+            block["downsample"] = nn.conv_init(next(keys), out_ch, out_ch)
+        enc["down"].append(block)
+    enc["mid"] = {
+        "resnet1": _resnet_init(next(keys), top, top),
+        "attn": _attn_init(next(keys), top),
+        "resnet2": _resnet_init(next(keys), top, top),
+    }
+    enc["norm_out"] = nn.norm_init(top)
+    enc["conv_out"] = nn.conv_init(next(keys), top, 2 * lat)   # mean ‖ logvar
+    enc["quant_conv"] = nn.conv_init(next(keys), 2 * lat, 2 * lat, kernel=1)
+
+    dec: Params = {
+        "post_quant_conv": nn.conv_init(next(keys), lat, lat, kernel=1),
+        "conv_in": nn.conv_init(next(keys), lat, top),
+        "mid": {
+            "resnet1": _resnet_init(next(keys), top, top),
+            "attn": _attn_init(next(keys), top),
+            "resnet2": _resnet_init(next(keys), top, top),
+        },
+        "up": [],
+    }
+    in_ch = top
+    for level in reversed(range(len(chs))):
+        out_ch = chs[level]
+        block = {"resnets": []}
+        for _ in range(cfg.layers_per_block + 1):
+            block["resnets"].append(_resnet_init(next(keys), in_ch, out_ch))
+            in_ch = out_ch
+        if level != 0:
+            block["upsample"] = nn.conv_init(next(keys), out_ch, out_ch)
+        dec["up"].append(block)
+    dec["norm_out"] = nn.norm_init(chs[0])
+    dec["conv_out"] = nn.conv_init(next(keys), chs[0], cfg.in_channels)
+
+    return {"encoder": enc, "decoder": dec}
+
+
+def encode_moments(params: Params, cfg: VAEConfig, image: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """image (B,H,W,3) in [-1,1] → posterior (mean, logvar), each
+    (B, H/8, W/8, latent_channels) for the SD VAE's 3 downsamples."""
+    p = params["encoder"]
+    g = cfg.groups
+    h = nn.conv2d(p["conv_in"], image)
+    for block in p["down"]:
+        for resnet in block["resnets"]:
+            h = _apply_resnet(resnet, h, g)
+        if "downsample" in block:
+            # diffusers pads (0,1)/(0,1) before the stride-2 conv.
+            h = jnp.pad(h, ((0, 0), (0, 1), (0, 1), (0, 0)))
+            h = nn.conv2d(block["downsample"], h, stride=2, padding="VALID")
+    h = _apply_resnet(p["mid"]["resnet1"], h, g)
+    h = _apply_attn(p["mid"]["attn"], h, g)
+    h = _apply_resnet(p["mid"]["resnet2"], h, g)
+    h = nn.conv2d(p["conv_out"], nn.silu(nn.group_norm(p["norm_out"], h, g)))
+    moments = nn.conv2d(p["quant_conv"], h)
+    mean, logvar = jnp.split(moments, 2, axis=-1)
+    return mean, jnp.clip(logvar, -30.0, 20.0)
+
+
+def encode(params: Params, cfg: VAEConfig, image: jax.Array) -> jax.Array:
+    """Deterministic latent: scaled posterior mean
+    (`/root/reference/null_text.py:527` uses ``.mean * 0.18215``)."""
+    mean, _ = encode_moments(params, cfg, image)
+    return mean * cfg.scaling_factor
+
+
+def decode(params: Params, cfg: VAEConfig, latents: jax.Array) -> jax.Array:
+    """latents (B,h,w,4) → image (B,H,W,3) in [-1,1]
+    (`/root/reference/ptp_utils.py:79-84`: input scaled by 1/0.18215)."""
+    p = params["decoder"]
+    g = cfg.groups
+    h = nn.conv2d(p["post_quant_conv"], latents / cfg.scaling_factor)
+    h = nn.conv2d(p["conv_in"], h)
+    h = _apply_resnet(p["mid"]["resnet1"], h, g)
+    h = _apply_attn(p["mid"]["attn"], h, g)
+    h = _apply_resnet(p["mid"]["resnet2"], h, g)
+    for block in p["up"]:
+        for resnet in block["resnets"]:
+            h = _apply_resnet(resnet, h, g)
+        if "upsample" in block:
+            b_, hh, ww, cc = h.shape
+            h = jax.image.resize(h, (b_, hh * 2, ww * 2, cc), method="nearest")
+            h = nn.conv2d(block["upsample"], h)
+    return nn.conv2d(p["conv_out"], nn.silu(nn.group_norm(p["norm_out"], h, g)))
+
+
+def to_uint8(image: jax.Array) -> jax.Array:
+    """[-1,1] float → uint8 HWC (`/root/reference/ptp_utils.py:82-84`)."""
+    return (jnp.clip(image / 2 + 0.5, 0.0, 1.0) * 255).astype(jnp.uint8)

@@ -1,0 +1,7 @@
+"""Multi-device execution: meshes, sharding rules, and sharded sweeps."""
+
+from .mesh import data_sharding, make_mesh, param_specs, shard_params
+from .sweep import seed_latents, sweep
+
+__all__ = ["data_sharding", "make_mesh", "param_specs", "shard_params",
+           "seed_latents", "sweep"]

@@ -1,0 +1,107 @@
+"""Device meshes and sharding rules.
+
+The reference is strictly single-device (`/root/reference/main.py:22-23`;
+SURVEY §2 "Parallelism: none"), so this subsystem is *introduced*, not ported.
+Axes:
+
+- ``dp`` — data parallel over independent work items (seeds, edit groups,
+  equalizer-sweep rows). The one hard constraint from the math: an edit
+  group's base+edit prompts read each other's attention maps
+  (`/root/reference/main.py:187`), so a group never splits across ``dp``.
+  Collective-free in the sampling loop; ICI traffic is zero until gather.
+- ``tp`` — tensor parallel over attention heads and FF hidden, for
+  single-image latency or models larger than a chip. XLA inserts the
+  all-reduces (psum over ``tp``) at `to_out`/`ff_out` from the param
+  shardings alone.
+
+`shard_params` maps a param pytree onto a mesh by path rules — the
+megatron-style column/row split expressed as NamedSharding specs.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    tp: int = 1,
+    axis_names: Tuple[str, str] = ("dp", "tp"),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A 2-D ``(dp, tp)`` mesh over the first ``n_devices`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = devices[:n_devices]
+    if n_devices % tp != 0:
+        raise ValueError(f"n_devices={n_devices} not divisible by tp={tp}")
+    grid = np.asarray(devices).reshape(n_devices // tp, tp)
+    return Mesh(grid, axis_names)
+
+
+# Path-pattern → PartitionSpec rules for the U-Net / text-encoder param trees.
+# Column-parallel (shard output features): q/k/v projections, ff_in, time MLPs.
+# Row-parallel (shard input features): to_out, ff_out — their matmul
+# contracts over the tp-sharded dim, so XLA emits one psum per attention/FF
+# block, the Megatron pattern.
+_TP_RULES: Tuple[Tuple[str, P], ...] = (
+    (r".*(to_q|to_k|to_v)/kernel$", P(None, "tp")),
+    (r".*(ff_in)/kernel$", P(None, "tp")),
+    (r".*(ff_in)/bias$", P("tp")),
+    (r".*(to_out|ff_out)/kernel$", P("tp", None)),
+    (r".*/(q|k|v|fc1)/kernel$", P(None, "tp")),
+    (r".*/(q|k|v|fc1)/bias$", P("tp")),
+    (r".*/(out|fc2)/kernel$", P("tp", None)),
+)
+
+
+def _spec_for_path(path: str, ndim: int, tp_size: int) -> P:
+    if tp_size > 1:
+        for pat, spec in _TP_RULES:
+            if re.match(pat, path):
+                # Verify the leaf has every axis the spec names (linear
+                # kernels are 2-D; a 1-D leaf must fall back to replication).
+                if ndim >= len(spec):
+                    return spec
+    return P()  # replicated
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def param_specs(params: Any, tp_size: int) -> Any:
+    """PartitionSpec pytree for a param tree under the tp rules."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: _spec_for_path(_path_str(path), getattr(x, "ndim", 0), tp_size),
+        params)
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Place a param pytree onto ``mesh`` per the tp rules (replicated over
+    ``dp``)."""
+    tp_size = mesh.shape["tp"]
+    specs = param_specs(params, tp_size)
+    return jax.tree_util.tree_map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params, specs)
+
+
+def data_sharding(mesh: Mesh, *batch_axis: Optional[str]) -> NamedSharding:
+    """NamedSharding for activations whose leading axis spans work items."""
+    return NamedSharding(mesh, P(*batch_axis))

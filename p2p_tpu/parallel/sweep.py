@@ -1,0 +1,98 @@
+"""Data-parallel sweeps: many edit groups at once across the mesh.
+
+The reference's CLI loops 10 seeds sequentially on one GPU
+(`/root/reference/main.py:417-444`); its equalizer sweep is a batch on one
+device (`/root/reference/main.py:281-290`). Here both become one
+``jax.vmap``-over-groups program sharded over the mesh's ``dp`` axis: each
+device holds whole edit groups (the base-prompt/edit-prompt co-location
+constraint, SURVEY §2), the sampling loop runs with **zero collectives**, and
+results gather once at the end. Group-count per call is static; sweep values
+(seeds, equalizer scales, thresholds, step windows) are traced leaves, so a
+new sweep re-uses the compiled program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..controllers.base import AttnLayout, Controller, init_store_state
+from ..engine.sampler import _denoise_scan
+from ..models import vae as vae_mod
+from ..models.config import PipelineConfig
+from ..ops import schedulers as sched_mod
+
+
+@partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind"),
+         donate_argnums=())
+def _sweep_jit(
+    unet_params: Any,
+    vae_params: Any,
+    cfg: PipelineConfig,
+    layout: AttnLayout,
+    schedule: sched_mod.DiffusionSchedule,
+    scheduler_kind: str,
+    context: jax.Array,        # (G, 2B, L, D) per-group [uncond; cond]
+    latents: jax.Array,        # (G, B, h, w, c)
+    controllers: Optional[Controller],   # leaves with leading G axis (or None)
+    guidance_scale: jax.Array,
+):
+    def one_group(ctx, lat, ctrl):
+        lat, state = _denoise_scan(
+            unet_params, cfg, layout, schedule, scheduler_kind, ctx, lat, ctrl,
+            guidance_scale)
+        image = vae_mod.decode(vae_params, cfg.vae, lat.astype(jnp.float32))
+        return vae_mod.to_uint8(image), lat
+
+    return jax.vmap(one_group)(context, latents, controllers)
+
+
+def sweep(
+    pipe,
+    context: jax.Array,
+    latents: jax.Array,
+    controllers: Optional[Controller],
+    *,
+    num_steps: int = 50,
+    guidance_scale: float = 7.5,
+    scheduler: str = "ddim",
+    layout: Optional[AttnLayout] = None,
+    mesh: Optional[Mesh] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Run G independent edit groups; shard the group axis over ``dp``.
+
+    ``context``: (G, 2B, L, D); ``latents``: (G, B, h, w, c);
+    ``controllers``: a Controller pytree whose array leaves carry a leading
+    G axis (same static structure per group — e.g. one edit with G equalizer
+    rows or G cross-window schedules), or None. Returns
+    ``(images (G,B,H,W,3) uint8, final latents)``.
+    """
+    cfg = pipe.config
+    if layout is None:
+        from ..models.config import unet_layout
+        layout = unet_layout(cfg.unet)
+    schedule = sched_mod.make_schedule(num_steps, kind=scheduler)
+    gs = jnp.asarray(guidance_scale, jnp.float32)
+
+    if mesh is not None:
+        gspec = NamedSharding(mesh, P("dp"))
+        context = jax.device_put(context, gspec)
+        latents = jax.device_put(latents, gspec)
+        if controllers is not None:
+            controllers = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, gspec), controllers)
+
+    return _sweep_jit(pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
+                      scheduler, context, latents, controllers, gs)
+
+
+def seed_latents(rng: jax.Array, n_groups: int, group_batch: int,
+                 shape: Tuple[int, int, int], dtype=jnp.float32) -> jax.Array:
+    """One shared latent per group, expanded over the group's prompt batch
+    (`/root/reference/ptp_utils.py:88-95` per group)."""
+    base = jax.random.normal(rng, (n_groups, 1) + tuple(shape), dtype=dtype)
+    return jnp.broadcast_to(base, (n_groups, group_batch) + tuple(shape))

@@ -1,0 +1,144 @@
+"""Visualization & analysis of stored attention — the observability surface.
+
+Behavioral spec: `/root/reference/ptp_utils.py:24-62` (`text_under_image`,
+`view_images`) and `/root/reference/main.py:293-350` (`aggregate_attention`,
+`show_cross_attention`, `show_self_attention_comp`). These operate on the
+averaged attention store, host-side numpy — they are debug outputs, not part
+of the compiled path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..controllers.base import AttnLayout, StoreState
+
+
+def text_under_image(image: np.ndarray, text: str,
+                     text_color: Tuple[int, int, int] = (0, 0, 0)) -> np.ndarray:
+    """Append a caption strip under an image (`/root/reference/ptp_utils.py:24-34`)."""
+    h, w, c = image.shape
+    offset = int(h * 0.2)
+    img = np.ones((h + offset, w, c), dtype=np.uint8) * 255
+    img[:h] = image
+    try:
+        import cv2
+
+        font = cv2.FONT_HERSHEY_SIMPLEX
+        textsize = cv2.getTextSize(text, font, 1, 2)[0]
+        text_x, text_y = (w - textsize[0]) // 2, h + offset - textsize[1] // 2
+        cv2.putText(img, text, (text_x, text_y), font, 1, text_color, 2)
+    except ImportError:  # pragma: no cover
+        from PIL import Image, ImageDraw
+
+        pil = Image.fromarray(img)
+        draw = ImageDraw.Draw(pil)
+        tw = draw.textlength(text)
+        draw.text(((w - tw) // 2, h + offset // 4), text, fill=text_color)
+        img = np.array(pil)
+    return img
+
+
+def view_images(images, num_rows: int = 1, offset_ratio: float = 0.02,
+                save_path: Optional[str] = None, show: bool = False) -> np.ndarray:
+    """Compose a grid (`/root/reference/ptp_utils.py:37-62`). Returns the
+    composed uint8 array; optionally saves/shows instead of requiring a
+    notebook display hook."""
+    if isinstance(images, np.ndarray) and images.ndim == 4:
+        images = [images[i] for i in range(images.shape[0])]
+    else:
+        images = [np.asarray(im) for im in images]
+    # Pad to a full grid (the reference computes `len % num_rows`,
+    # `/root/reference/ptp_utils.py:40`, which under-pads and silently drops
+    # trailing images for some counts — fixed by design).
+    num_empty = (num_rows - len(images) % num_rows) % num_rows
+
+    empty = np.ones_like(images[0]) * 255
+    images = [np.asarray(im, dtype=np.uint8) for im in images] + [empty] * num_empty
+    num_items = len(images)
+
+    h, w, c = images[0].shape
+    offset = int(h * offset_ratio)
+    num_cols = num_items // num_rows
+    grid = np.ones((h * num_rows + offset * (num_rows - 1),
+                    w * num_cols + offset * (num_cols - 1), 3), dtype=np.uint8) * 255
+    for i in range(num_rows):
+        for j in range(num_cols):
+            grid[i * (h + offset): i * (h + offset) + h,
+                 j * (w + offset): j * (w + offset) + w] = images[i * num_cols + j]
+
+    if save_path is not None:
+        from PIL import Image
+
+        Image.fromarray(grid).save(save_path)
+    if show:  # pragma: no cover
+        from PIL import Image
+
+        Image.fromarray(grid).show()
+    return grid
+
+
+def aggregate_attention(layout: AttnLayout, state: StoreState, num_steps: int,
+                        res: int, from_where: Sequence[str], is_cross: bool,
+                        select: int) -> np.ndarray:
+    """Average stored maps of one resolution across layers & heads
+    (`/root/reference/main.py:293-307`). Returns (res, res, K)."""
+    out = []
+    for m in layout.stored_metas():
+        if m.is_cross != is_cross or m.resolution != res or m.place not in from_where:
+            continue
+        acc = np.asarray(state[m.store_slot]) / num_steps    # (B, heads, P, K)
+        maps = acc[select].reshape(-1, res, res, acc.shape[-1])
+        out.append(maps)
+    if not out:
+        raise ValueError(f"no stored {'cross' if is_cross else 'self'} maps at "
+                         f"resolution {res} from {from_where}")
+    return np.concatenate(out, axis=0).mean(0)
+
+
+def show_cross_attention(tokenizer, prompt: str, layout: AttnLayout,
+                         state: StoreState, num_steps: int, res: int,
+                         from_where: Sequence[str], select: int = 0,
+                         save_path: Optional[str] = None) -> np.ndarray:
+    """Per-token attention heatmaps with decoded-token captions
+    (`/root/reference/main.py:310-327`)."""
+    from PIL import Image
+
+    ids = tokenizer.encode(prompt)
+    decoder = lambda t: tokenizer.decode([t])
+    maps = aggregate_attention(layout, state, num_steps, res, from_where, True,
+                               select)
+    images = []
+    for i in range(len(ids)):
+        m = maps[:, :, i]
+        m = 255 * m / (m.max() + 1e-12)
+        m = np.tile(m[:, :, None], (1, 1, 3)).astype(np.uint8)
+        m = np.array(Image.fromarray(m).resize((256, 256)))
+        m = text_under_image(m, decoder(int(ids[i])))
+        images.append(m)
+    return view_images(np.stack(images, axis=0), save_path=save_path)
+
+
+def show_self_attention_comp(layout: AttnLayout, state: StoreState,
+                             num_steps: int, res: int,
+                             from_where: Sequence[str], max_com: int = 10,
+                             select: int = 0,
+                             save_path: Optional[str] = None) -> np.ndarray:
+    """Top-k SVD components of the (res², res²) self-attention matrix
+    (`/root/reference/main.py:330-350`)."""
+    from PIL import Image
+
+    attn = aggregate_attention(layout, state, num_steps, res, from_where, False,
+                               select).astype(np.float64).reshape(res * res, res * res)
+    u, s, vh = np.linalg.svd(attn - attn.mean(1, keepdims=True))
+    images = []
+    for i in range(max_com):
+        image = vh[i].reshape(res, res)
+        image = image - image.min()
+        image = 255 * image / image.max()
+        image = np.tile(image[:, :, None], (1, 1, 3)).astype(np.uint8)
+        image = np.array(Image.fromarray(image).resize((256, 256)))
+        images.append(image)
+    return view_images(np.stack(images, axis=0), save_path=save_path)

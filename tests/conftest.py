@@ -13,11 +13,37 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Some TPU PJRT plugins (axon) register regardless of JAX_PLATFORMS; the
+# config override below wins either way.
+import jax
+
+jax.config.update("jax_platform_name", "cpu")
+
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tiny_pipe():
+    """Random-init tiny pipeline shared by the end-to-end test modules."""
+    import jax
+
+    from p2p_tpu.engine.sampler import Pipeline
+    from p2p_tpu.models import TINY, init_text_encoder, init_unet
+    from p2p_tpu.models import vae as vae_mod
+    from p2p_tpu.utils.tokenizer import HashWordTokenizer
+
+    tok = HashWordTokenizer(model_max_length=TINY.text.max_length)
+    return Pipeline(
+        config=TINY,
+        unet_params=init_unet(jax.random.PRNGKey(0), TINY.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(1), TINY.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(2), TINY.vae),
+        tokenizer=tok,
+    )
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,102 @@
+"""Checkpoint-mapping tests: the diffusers-name tables must cover every leaf
+of our param trees, and export → apply must round-trip exactly.
+
+This validates the loader without any real SD weights in the environment
+(SURVEY §7 step 2's weight-loading risk, de-risked synthetically).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_tpu.models import TINY, init_text_encoder, init_unet
+from p2p_tpu.models import vae as vae_mod
+from p2p_tpu.models.checkpoint import (
+    apply_state_dict,
+    export_state_dict,
+    text_encoder_entries,
+    unet_entries,
+    vae_entries,
+)
+from p2p_tpu.models.config import SD14_TEXT, SD14_UNET, SD14_VAE
+
+
+def _leaf_paths(tree, prefix=()):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _leaf_paths(v, prefix + (k,))
+    elif isinstance(tree, list):
+        for i, v in enumerate(tree):
+            yield from _leaf_paths(v, prefix + (i,))
+    else:
+        yield prefix
+
+
+@pytest.mark.parametrize("which", ["unet", "text", "vae"])
+def test_entries_cover_every_leaf(which):
+    if which == "unet":
+        params = init_unet(jax.random.PRNGKey(0), TINY.unet)
+        entries = unet_entries(TINY.unet)
+    elif which == "text":
+        params = init_text_encoder(jax.random.PRNGKey(0), TINY.text)
+        entries = text_encoder_entries(TINY.text)
+    else:
+        params = vae_mod.init_vae(jax.random.PRNGKey(0), TINY.vae)
+        entries = vae_entries(TINY.vae)
+
+    ours = set(_leaf_paths(params))
+    mapped = {p for p, _, _ in entries}
+    assert mapped == ours, (
+        f"unmapped leaves: {sorted(ours - mapped)[:5]}; "
+        f"spurious entries: {sorted(mapped - ours)[:5]}")
+    names = [n for _, n, _ in entries]
+    assert len(names) == len(set(names)), "duplicate checkpoint names"
+
+
+@pytest.mark.parametrize("which", ["unet", "text", "vae"])
+def test_export_apply_roundtrip(which):
+    if which == "unet":
+        src = init_unet(jax.random.PRNGKey(1), TINY.unet)
+        dst = init_unet(jax.random.PRNGKey(2), TINY.unet)
+        entries = unet_entries(TINY.unet)
+    elif which == "text":
+        src = init_text_encoder(jax.random.PRNGKey(1), TINY.text)
+        dst = init_text_encoder(jax.random.PRNGKey(2), TINY.text)
+        entries = text_encoder_entries(TINY.text)
+    else:
+        src = vae_mod.init_vae(jax.random.PRNGKey(1), TINY.vae)
+        dst = vae_mod.init_vae(jax.random.PRNGKey(2), TINY.vae)
+        entries = vae_entries(TINY.vae)
+
+    sd = export_state_dict(src, entries)
+    dst = apply_state_dict(dst, entries, sd, strict=True)
+    for a, b in zip(jax.tree_util.tree_leaves(src), jax.tree_util.tree_leaves(dst)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sd14_table_sizes():
+    """SD-1.4 shape sanity: the tables must address the real checkpoint's
+    tensor counts (diffusers 0.8.1 SD-v1.4: 686 unet, 196 text-encoder
+    (+position_ids, which we derive), 248 vae tensors)."""
+    assert len(unet_entries(SD14_UNET)) == 686
+    assert len(text_encoder_entries(SD14_TEXT)) == 196
+    assert len(vae_entries(SD14_VAE)) == 248
+
+
+def test_strict_mode_flags_problems():
+    params = init_text_encoder(jax.random.PRNGKey(0), TINY.text)
+    entries = text_encoder_entries(TINY.text)
+    sd = export_state_dict(params, entries)
+    missing = dict(sd)
+    missing.pop("text_model.final_layer_norm.weight")
+    with pytest.raises(KeyError):
+        apply_state_dict(params, entries, missing, strict=True)
+    extra = dict(sd)
+    extra["text_model.mystery.weight"] = np.zeros(3)
+    with pytest.raises(KeyError):
+        apply_state_dict(params, entries, extra, strict=True)
+    bad = dict(sd)
+    bad["text_model.final_layer_norm.weight"] = np.zeros((999,))
+    with pytest.raises(ValueError):
+        apply_state_dict(params, entries, bad, strict=True)
