@@ -50,11 +50,14 @@ def main():
         self_max_pixels=16 * 16 if on_accel else 8 * 8,
         max_len=cfg.text.max_length)
 
+    import numpy as np
+
     def run(seed):
         img, _, _ = text2image(pipe, prompts, controller, num_steps=num_steps,
                                rng=jax.random.PRNGKey(seed), dtype=dtype)
-        jax.block_until_ready(img)
-        return img
+        # np.asarray forces device execution + host transfer; on the tunneled
+        # axon platform block_until_ready returns before execution finishes.
+        return np.asarray(img)
 
     run(0)  # compile
     n_runs = 3

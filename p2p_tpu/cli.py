@@ -1,0 +1,256 @@
+"""Command-line driver.
+
+The reference's CLI (`/root/reference/main.py:386-482`) is a fixed-prompt seed
+sweep with a `--type {global,local}` switch and an unread `--path config.yaml`
+flag; its real edit surface (`make_controller`) is notebook-only. Here the
+whole edit API is on the command line:
+
+    python -m p2p_tpu.cli generate --prompt "a cat" --out out.png
+    python -m p2p_tpu.cli edit --source "a cat riding a bike" \
+        --target "a dog riding a bike" --mode replace --seeds 1,2,3 \
+        --blend-words cat,dog --out-dir logs/run1
+    python -m p2p_tpu.cli invert --image cat.png --prompt "a cat" \
+        --artifact cat_inv.npz
+    python -m p2p_tpu.cli replay --artifact cat_inv.npz \
+        --target "a tiger" --mode replace --out-dir logs/replay
+
+Presets: ``tiny`` (random weights, fast — the default when no checkpoint is
+given), ``sd14``/``ldm256`` (SD-1.4 / LDM-256 shapes; random weights unless
+``--checkpoint`` points at a diffusers-format directory). Every edit run
+writes the baseline/edited pair like `run_and_display`
+(`/root/reference/main.py:353-383`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+def _build_pipeline(args):
+    import jax
+
+    from .engine.sampler import Pipeline
+    from .models import LDM256, SD14, TINY, init_text_encoder, init_unet
+    from .models import vae as vae_mod
+    from .utils.tokenizer import HashWordTokenizer
+
+    cfg = {"tiny": TINY, "sd14": SD14, "ldm256": LDM256}[args.preset]
+    if args.checkpoint:
+        from .models.checkpoint import load_pipeline
+
+        return load_pipeline(args.checkpoint, cfg)
+    tok = HashWordTokenizer(model_max_length=cfg.text.max_length)
+    return Pipeline(
+        config=cfg,
+        unet_params=init_unet(jax.random.PRNGKey(0), cfg.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(1), cfg.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae),
+        tokenizer=tok,
+    )
+
+
+def _save(img: np.ndarray, path: str) -> None:
+    from PIL import Image
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    Image.fromarray(np.asarray(img)).save(path)
+    print(f"wrote {path}")
+
+
+def _parse_equalizer(spec: Optional[str]):
+    if not spec:
+        return None
+    words, values = [], []
+    for part in spec.split(","):
+        w, v = part.split("=")
+        words.append(w.strip())
+        values.append(float(v))
+    return {"words": tuple(words), "values": tuple(values)}
+
+
+def _make_controller(args, prompts, tokenizer, num_steps):
+    from .controllers.factory import make_controller
+
+    blend = args.blend_words.split(",") if args.blend_words else None
+    if blend is not None:
+        blend = [blend] * len(prompts)
+    return make_controller(
+        prompts,
+        is_replace_controller=args.mode == "replace",
+        cross_replace_steps=args.cross_steps,
+        self_replace_steps=args.self_steps,
+        tokenizer=tokenizer,
+        num_steps=num_steps,
+        blend_words=blend,
+        equalizer_params=_parse_equalizer(args.equalizer),
+        blend_resolution=args.blend_resolution,
+    )
+
+
+def cmd_generate(args) -> int:
+    import jax
+
+    from .engine.sampler import text2image
+
+    pipe = _build_pipeline(args)
+    for seed in args.seeds:
+        img, _, _ = text2image(pipe, [args.prompt], None, num_steps=args.steps,
+                               guidance_scale=args.guidance,
+                               scheduler=args.scheduler,
+                               rng=jax.random.PRNGKey(seed))
+        out = args.out
+        if len(args.seeds) > 1:
+            root, ext = os.path.splitext(out)
+            out = f"{root}_{seed:05d}{ext}"
+        _save(np.asarray(img[0]), out)
+    return 0
+
+
+def cmd_edit(args) -> int:
+    import jax
+
+    from .engine.sampler import text2image
+
+    pipe = _build_pipeline(args)
+    prompts = [args.source, args.target]
+    controller = _make_controller(args, prompts, pipe.tokenizer, args.steps)
+    out_dir = args.out_dir or os.path.join("logs", time.strftime("%y%m%d_%H%M%S"))
+    for seed in args.seeds:
+        rng = jax.random.PRNGKey(seed)
+        base, x_t, _ = text2image(pipe, prompts, None, num_steps=args.steps,
+                                  guidance_scale=args.guidance,
+                                  scheduler=args.scheduler, rng=rng)
+        img, _, _ = text2image(pipe, prompts, controller, num_steps=args.steps,
+                               guidance_scale=args.guidance,
+                               scheduler=args.scheduler, latent=x_t)
+        # y / y_hat naming per `/root/reference/main.py:375-380,435-444`.
+        _save(np.asarray(base[0]), os.path.join(out_dir, f"{seed:05d}_y.jpg"))
+        _save(np.asarray(img[1]), os.path.join(out_dir, f"{seed:05d}_y_hat.jpg"))
+    return 0
+
+
+def cmd_invert(args) -> int:
+    from .engine.inversion import invert, load_image
+
+    pipe = _build_pipeline(args)
+    image = load_image(args.image, size=pipe.config.image_size)
+    art = invert(pipe, image, args.prompt, num_steps=args.steps,
+                 guidance_scale=args.guidance,
+                 num_inner_steps=args.inner_steps)
+    art.save(args.artifact)
+    print(f"wrote {args.artifact}")
+    if args.out_dir:
+        _save(art.image_gt, os.path.join(args.out_dir, "gt.png"))
+        _save(art.image_rec, os.path.join(args.out_dir, "vae_rec.png"))
+    return 0
+
+
+def cmd_replay(args) -> int:
+    import jax.numpy as jnp
+
+    from .engine.inversion import InversionArtifact
+    from .engine.sampler import text2image
+
+    pipe = _build_pipeline(args)
+    art = InversionArtifact.load(args.artifact)
+    prompts = [art.prompt, args.target] if args.target else [art.prompt]
+    controller = (None if len(prompts) == 1 else
+                  _make_controller(args, prompts, pipe.tokenizer, art.num_steps))
+    img, _, _ = text2image(
+        pipe, prompts, controller, num_steps=art.num_steps,
+        guidance_scale=args.guidance, latent=jnp.asarray(art.x_t),
+        uncond_embeddings=jnp.asarray(art.uncond_embeddings))
+    out_dir = args.out_dir or "outputs"
+    _save(np.asarray(img[0]), os.path.join(out_dir, "reconstruction.png"))
+    if len(prompts) > 1:
+        _save(np.asarray(img[1]), os.path.join(out_dir, "edited.png"))
+    return 0
+
+
+def _int_list(s: str) -> List[int]:
+    return [int(x) for x in s.split(",") if x]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="p2p_tpu", description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    # Each subcommand declares exactly the flags it honors — no
+    # accepted-but-ignored options (the reference's unread `--path
+    # config.yaml`, `/root/reference/main.py:388`, is the anti-pattern).
+    def model_opts(sp):
+        sp.add_argument("--preset", choices=("tiny", "sd14", "ldm256"),
+                        default="tiny")
+        sp.add_argument("--checkpoint", default=None,
+                        help="diffusers-format checkpoint dir (unet/ vae/ ...)")
+        sp.add_argument("--guidance", type=float, default=7.5)
+
+    def sampling_opts(sp):
+        sp.add_argument("--steps", type=int, default=50)
+        sp.add_argument("--scheduler", choices=("ddim", "plms"), default="ddim")
+        sp.add_argument("--seeds", type=_int_list, default=[8191],
+                        help="comma-separated seed sweep")
+
+    def edit_opts(sp):
+        sp.add_argument("--mode", choices=("replace", "refine"),
+                        default="refine")
+        sp.add_argument("--cross-steps", type=float, default=0.8)
+        sp.add_argument("--self-steps", type=float, default=0.4)
+        sp.add_argument("--blend-words", default=None,
+                        help="comma-separated words for LocalBlend masking")
+        sp.add_argument("--equalizer", default=None,
+                        help="word=scale[,word=scale...] reweighting")
+        sp.add_argument("--blend-resolution", type=int, default=16)
+
+    g = sub.add_parser("generate", help="text-to-image, no editing")
+    model_opts(g); sampling_opts(g)
+    g.add_argument("--prompt", required=True)
+    g.add_argument("--out", default="outputs/image.png",
+                   help="output path; seed index suffixed when sweeping")
+    g.set_defaults(fn=cmd_generate)
+
+    e = sub.add_parser("edit", help="prompt-to-prompt edit with seed sweep")
+    model_opts(e); sampling_opts(e); edit_opts(e)
+    e.add_argument("--source", required=True, help="source prompt")
+    e.add_argument("--target", required=True, help="edited prompt")
+    e.add_argument("--out-dir", default=None)
+    e.set_defaults(fn=cmd_edit)
+
+    # Inversion is DDIM by construction (`/root/reference/null_text.py:23`);
+    # no --scheduler/--seeds here.
+    i = sub.add_parser("invert", help="null-text inversion of a real image")
+    model_opts(i)
+    i.add_argument("--steps", type=int, default=50)
+    i.add_argument("--image", required=True)
+    i.add_argument("--prompt", required=True)
+    i.add_argument("--artifact", default="outputs/inversion.npz")
+    i.add_argument("--inner-steps", type=int, default=10)
+    i.add_argument("--out-dir", default=None,
+                   help="also write gt.png / vae_rec.png here")
+    i.set_defaults(fn=cmd_invert)
+
+    # Replay inherits step count and scheduler from the artifact.
+    r = sub.add_parser("replay", help="edit a previously inverted image")
+    model_opts(r); edit_opts(r)
+    r.add_argument("--artifact", required=True)
+    r.add_argument("--target", default=None,
+                   help="edited prompt (omit for pure reconstruction)")
+    r.add_argument("--out-dir", default=None)
+    r.set_defaults(fn=cmd_replay)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -237,13 +237,15 @@ def _apply_spatial_transformer(p: Params, x: jax.Array, context: jax.Array,
     b, h, w, c = x.shape
     residual = x
     x = nn.group_norm(p["norm"], x, cfg.groups, eps=1e-6)
-    x = nn.conv2d(p["proj_in"], x)
+    # proj_in/proj_out are 1×1 convs in the checkpoint; applied as linears in
+    # token-major space so the whole transformer stack stays (B, P, C) with no
+    # spatial relayouts between the convs and the attention matmuls.
     x = x.reshape(b, h * w, c)
+    x = nn.linear_1x1(p["proj_in"], x)
     for block in p["blocks"]:
         x = _apply_transformer_block(block, x, context, cfg.num_heads, ctx)
-    x = x.reshape(b, h, w, c)
-    x = nn.conv2d(p["proj_out"], x)
-    return x + residual
+    x = nn.linear_1x1(p["proj_out"], x)
+    return x.reshape(b, h, w, c) + residual
 
 
 def apply_unet(

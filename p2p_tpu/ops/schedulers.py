@@ -123,9 +123,13 @@ def ddim_step(
     prev_t = t - sched.step_size
     a_t = _alpha_at(sched, t)
     a_prev = _alpha_at(sched, prev_t)
-    pred_x0 = (sample - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
-    direction = jnp.sqrt(1.0 - a_prev) * eps
-    return jnp.sqrt(a_prev) * pred_x0 + direction
+    x = sample.astype(jnp.float32)
+    e = eps.astype(jnp.float32)
+    pred_x0 = (x - jnp.sqrt(1.0 - a_t) * e) / jnp.sqrt(a_t)
+    direction = jnp.sqrt(1.0 - a_prev) * e
+    # Step math in f32 regardless of compute dtype (the constants span 4
+    # orders of magnitude); carry dtype is preserved for the scan.
+    return (jnp.sqrt(a_prev) * pred_x0 + direction).astype(sample.dtype)
 
 
 def ddim_next_step(
@@ -137,9 +141,11 @@ def ddim_next_step(
     next_t = t
     a_t = _alpha_at(sched, cur_t)
     a_next = _alpha_at(sched, next_t)
-    pred_x0 = (sample - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
-    direction = jnp.sqrt(1.0 - a_next) * eps
-    return jnp.sqrt(a_next) * pred_x0 + direction
+    x = sample.astype(jnp.float32)
+    e = eps.astype(jnp.float32)
+    pred_x0 = (x - jnp.sqrt(1.0 - a_t) * e) / jnp.sqrt(a_t)
+    direction = jnp.sqrt(1.0 - a_next) * e
+    return (jnp.sqrt(a_next) * pred_x0 + direction).astype(sample.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -173,7 +179,9 @@ def _plms_prev_sample(sched, sample, t, prev_t, eps):
     b_prev = 1.0 - a_prev
     sample_coeff = jnp.sqrt(a_prev / a_t)
     denom = a_t * jnp.sqrt(b_prev) + jnp.sqrt(a_t * b_t * a_prev)
-    return sample_coeff * sample - (a_prev - a_t) * eps / denom
+    out = (sample_coeff * sample.astype(jnp.float32)
+           - (a_prev - a_t) * eps.astype(jnp.float32) / denom)
+    return out.astype(sample.dtype)
 
 
 def plms_step(
@@ -245,13 +253,16 @@ def ddpm_step(
     a_prev = _alpha_at(sched, prev_t)
     alpha_ratio = a_t / a_prev
     beta_t = 1.0 - alpha_ratio
-    pred_x0 = (sample - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
+    x = sample.astype(jnp.float32)
+    e = eps.astype(jnp.float32)
+    pred_x0 = (x - jnp.sqrt(1.0 - a_t) * e) / jnp.sqrt(a_t)
     x0_coeff = jnp.sqrt(a_prev) * beta_t / (1.0 - a_t)
     xt_coeff = jnp.sqrt(alpha_ratio) * (1.0 - a_prev) / (1.0 - a_t)
-    mean = x0_coeff * pred_x0 + xt_coeff * sample
+    mean = x0_coeff * pred_x0 + xt_coeff * x
     var = beta_t * (1.0 - a_prev) / (1.0 - a_t)
-    noise = jax.random.normal(rng, sample.shape, dtype=sample.dtype)
-    return jnp.where(prev_t >= 0, mean + jnp.sqrt(jnp.maximum(var, 0.0)) * noise, mean)
+    noise = jax.random.normal(rng, sample.shape, dtype=jnp.float32)
+    out = jnp.where(prev_t >= 0, mean + jnp.sqrt(jnp.maximum(var, 0.0)) * noise, mean)
+    return out.astype(sample.dtype)
 
 
 def add_noise(
