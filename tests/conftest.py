@@ -9,12 +9,15 @@ These env vars must be set before the first `import jax` anywhere.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env may preset a TPU platform
+# The axon TPU PJRT plugin is registered by sitecustomize whenever
+# PALLAS_AXON_POOL_IPS is set, regardless of JAX_PLATFORMS, and a wedged TPU
+# lease then hangs the whole suite at first backend use. Scrub it so the CPU
+# suite never touches the TPU plugin at all.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
-# Some TPU PJRT plugins (axon) register regardless of JAX_PLATFORMS; the
-# config override below wins either way.
 import jax
 
 jax.config.update("jax_platform_name", "cpu")
