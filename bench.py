@@ -1,35 +1,111 @@
 """Headline benchmark: 50-step SD-v1.4 512² AttentionReplace 2-prompt edit.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} — ALWAYS,
+even when the TPU backend is wedged (the axon plugin can hang or raise at
+first backend use; see tests/conftest.py). Structure:
+
+  parent (no jax import): probe the accelerator in a subprocess with a
+  timeout, retrying with backoff; run the measurement in a subprocess so a
+  hang can never eat the whole round; fall back to a CPU measurement in a
+  scrubbed env; as a last resort print a "backend_unavailable" line.
+
 Baseline: ≥4 img/s/chip on TPU (driver north star, BASELINE.md). Weights are
 random-init (no checkpoint in the image) — throughput is weight-agnostic.
 """
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
+
+def _cpu_env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never register the TPU plugin
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _probe_accelerator(timeout=180, attempts=3, backoffs=(15, 45)):
+    """True iff a non-CPU jax backend initializes within `timeout` seconds."""
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    for i in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], env=dict(os.environ),
+                timeout=timeout, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+            for line in proc.stdout.splitlines():
+                if line.startswith("PLATFORM="):
+                    return line.split("=", 1)[1] != "cpu"
+        except subprocess.TimeoutExpired:
+            pass
+        if i < attempts - 1:
+            time.sleep(backoffs[min(i, len(backoffs) - 1)])
+    return False
+
+
+def _run_inner(preset, env, timeout):
+    """Run the measurement subprocess; return the parsed JSON line or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--inner", preset],
+            env=env, timeout=timeout, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+                if "metric" in obj:
+                    return obj
+            except json.JSONDecodeError:
+                continue
+    return None
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", choices=("auto", "sd14", "tiny"), default="auto",
+                    help="auto: sd14 on an accelerator, tiny on CPU")
+    ap.add_argument("--inner", metavar="PRESET",
+                    help=argparse.SUPPRESS)  # measurement child process
+    args = ap.parse_args()
+
+    if args.inner:
+        return _measure(args.inner)
+
+    preset = args.preset
+    result = None
+    if preset != "tiny" and _probe_accelerator():
+        result = _run_inner("sd14", dict(os.environ), timeout=1800)
+        if result is None:  # one retry: transient lease wedges do clear
+            time.sleep(30)
+            result = _run_inner("sd14", dict(os.environ), timeout=1800)
+    if result is None:
+        result = _run_inner("tiny", _cpu_env(), timeout=900)
+    if result is None:
+        result = {"metric": "backend_unavailable", "value": 0.0,
+                  "unit": "img/s/chip", "vs_baseline": 0.0}
+    print(json.dumps(result))
+    return 0
+
+
+def _measure(preset):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from p2p_tpu.controllers import factory
     from p2p_tpu.engine.sampler import Pipeline, text2image
     from p2p_tpu.models import SD14, TINY, init_text_encoder, init_unet
     from p2p_tpu.models import vae as vae_mod
     from p2p_tpu.utils.tokenizer import HashWordTokenizer
 
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--preset", choices=("auto", "sd14", "tiny"), default="auto",
-                    help="auto: sd14 on an accelerator, tiny on CPU")
-    args = ap.parse_args()
-
-    platform = jax.devices()[0].platform
-    preset = args.preset
-    if preset == "auto":
-        preset = "sd14" if platform != "cpu" else "tiny"
     on_accel = preset == "sd14"
     cfg = SD14 if on_accel else TINY
     num_steps = 50 if on_accel else 4
@@ -49,8 +125,6 @@ def main():
         tokenizer=tok,
         self_max_pixels=16 * 16 if on_accel else 8 * 8,
         max_len=cfg.text.max_length)
-
-    import numpy as np
 
     def run(seed):
         img, _, _ = text2image(pipe, prompts, controller, num_steps=num_steps,
@@ -75,6 +149,7 @@ def main():
         "unit": "img/s/chip",
         "vs_baseline": round(imgs_per_s / baseline, 4),
     }))
+    return 0
 
 
 if __name__ == "__main__":
