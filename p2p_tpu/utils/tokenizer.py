@@ -22,6 +22,7 @@ import gzip
 import hashlib
 import json
 import os
+import unicodedata
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol, Sequence, Tuple
 
@@ -164,6 +165,36 @@ except ImportError:  # pragma: no cover
     )
 
 
+def _strip_controls_pad_cjk(text: str) -> str:
+    """Shared normalization pre-pass (HF BasicTokenizer semantics): drop
+    control chars / U+FFFD, space-pad CJK ideographs, fold whitespace chars
+    to plain spaces. Used by both the CLIP and BERT tokenizers — keep in one
+    place so Unicode edge-case fixes can't diverge."""
+    out = []
+    for ch in text:
+        cp = ord(ch)
+        if cp == 0 or cp == 0xFFFD or (unicodedata.category(ch).startswith("C")
+                                       and ch not in "\t\n\r"):
+            continue
+        if _is_cjk(cp):
+            out.append(f" {ch} ")
+        elif ch in "\t\n\r" or unicodedata.category(ch) == "Zs":
+            out.append(" ")
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _is_cjk(cp: int) -> bool:
+    """CJK ideograph ranges (the set HF's BasicTokenizer space-pads)."""
+    return (
+        0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+        or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+        or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+        or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F
+    )
+
+
 def _bytes_to_unicode() -> Dict[int, str]:
     """GPT-2/CLIP reversible byte→unicode table (standard public algorithm)."""
     bs = (
@@ -250,14 +281,24 @@ class ClipBpeTokenizer:
         return out
 
     def _basic_clean(self, text: str) -> List[str]:
-        text = " ".join(text.lower().strip().split())
+        """Normalize exactly as ``transformers.CLIPTokenizer`` does without
+        ftfy (its BasicTokenizer path, strip_accents=False,
+        do_split_on_punc=False): drop control chars, space-pad CJK ideographs,
+        NFC-normalize, whitespace-split, lowercase. Golden-tested against the
+        HF tokenizer in tests/test_tokenizer.py."""
+        text = unicodedata.normalize("NFC", _strip_controls_pad_cjk(text))
+        text = " ".join(w.lower() for w in text.split())
         return _CLIP_PAT.findall(text)
 
     def encode(self, text: str) -> List[int]:
+        # OOV subwords map to the unk token (= <|endoftext|>), matching HF's
+        # CLIPTokenizer unk_token default rather than raising KeyError. With a
+        # full CLIP vocab (all 256 byte symbols present) this never triggers.
+        unk = self.eos_token_id
         ids = [self.bos_token_id]
         for token in self._basic_clean(text):
             token = "".join(self.byte_encoder[b] for b in token.encode("utf-8"))
-            ids.extend(self.encoder[t] for t in self._bpe(token).split(" "))
+            ids.extend(self.encoder.get(t, unk) for t in self._bpe(token).split(" "))
         ids.append(self.eos_token_id)
         return ids
 
@@ -273,4 +314,117 @@ class ClipBpeTokenizer:
             texts = [texts]
         max_length = max_length or self.model_max_length
         batch = [pad_ids(self.encode(t), max_length, self.pad_token_id) for t in texts]
+        return {"input_ids": batch}
+
+
+# ---------------------------------------------------------------------------
+# BertWordPieceTokenizer — the LDM-256 backend's text tokenizer
+# ---------------------------------------------------------------------------
+
+
+class BertWordPieceTokenizer:
+    """bert-base-uncased WordPiece, loading ``vocab.txt`` from disk.
+
+    The LDM-256 pipeline tokenizes with the BERT tokenizer before its
+    `model.bert` encoder (`/root/reference/ptp_utils.py:112-116`). Surface
+    matches :class:`Tokenizer`: ``encode`` wraps in [CLS]/[SEP] (= bos/eos),
+    pads with [PAD]=0; per-token ``decode`` yields "##"-prefixed subwords that
+    the word-index lookup strips (`/root/reference/ptp_utils.py:253` does
+    ``.strip("#")`` precisely for this). Normalization mirrors HF's
+    BasicTokenizer for the uncased model: lower-case, strip accents, split
+    punctuation, space-pad CJK. Golden-tested vs ``transformers.BertTokenizer``
+    in tests/test_tokenizer.py.
+    """
+
+    def __init__(self, vocab_path: str, model_max_length: int = 77):
+        self.vocab: Dict[str, int] = {}
+        with open(vocab_path, "r", encoding="utf-8") as f:
+            for line in f:
+                tok = line.rstrip("\n")
+                if tok:
+                    self.vocab[tok] = len(self.vocab)
+        self.ids_to_tokens = {v: k for k, v in self.vocab.items()}
+        self.model_max_length = model_max_length
+        self.bos_token_id = self.vocab["[CLS]"]
+        self.eos_token_id = self.vocab["[SEP]"]
+        self.pad_token_id = self.vocab["[PAD]"]
+        self.unk_token_id = self.vocab["[UNK]"]
+        self.max_chars_per_word = 100
+
+    @classmethod
+    def from_dir(cls, path: str, **kw) -> "BertWordPieceTokenizer":
+        return cls(os.path.join(path, "vocab.txt"), **kw)
+
+    @staticmethod
+    def _is_punct(ch: str) -> bool:
+        cp = ord(ch)
+        if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96 or 123 <= cp <= 126):
+            return True
+        return unicodedata.category(ch).startswith("P")
+
+    def _basic_tokenize(self, text: str) -> List[str]:
+        words = _strip_controls_pad_cjk(text).split()
+        tokens: List[str] = []
+        for w in words:
+            w = w.lower()
+            # strip accents (uncased model): NFD then drop Mn marks
+            w = "".join(c for c in unicodedata.normalize("NFD", w)
+                        if unicodedata.category(c) != "Mn")
+            # split on punctuation, keeping each punct char as its own token
+            cur = ""
+            for ch in w:
+                if self._is_punct(ch):
+                    if cur:
+                        tokens.append(cur)
+                        cur = ""
+                    tokens.append(ch)
+                else:
+                    cur += ch
+            if cur:
+                tokens.append(cur)
+        return tokens
+
+    def _wordpiece(self, word: str) -> List[int]:
+        if len(word) > self.max_chars_per_word:
+            return [self.unk_token_id]
+        ids: List[int] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                piece = word[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    cur = self.vocab[piece]
+                    break
+                end -= 1
+            if cur is None:
+                return [self.unk_token_id]  # whole word becomes [UNK]
+            ids.append(cur)
+            start = end
+        return ids
+
+    def encode(self, text: str) -> List[int]:
+        ids = [self.bos_token_id]
+        for word in self._basic_tokenize(text):
+            ids.extend(self._wordpiece(word))
+        ids.append(self.eos_token_id)
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        toks = [self.ids_to_tokens.get(int(i), "[UNK]") for i in ids
+                if int(i) not in (self.bos_token_id, self.eos_token_id,
+                                  self.pad_token_id)]
+        text = " ".join(toks).replace(" ##", "")
+        return text
+
+    def __call__(self, texts, padding: str = "max_length",
+                 max_length: Optional[int] = None, truncation: bool = True):
+        if isinstance(texts, str):
+            texts = [texts]
+        max_length = max_length or self.model_max_length
+        batch = [pad_ids(self.encode(t), max_length, self.pad_token_id)
+                 for t in texts]
         return {"input_ids": batch}
