@@ -207,7 +207,9 @@ def invert(
         image_f = image_f[None]
     image_j = jnp.asarray(image_f, dtype)
 
-    schedule = sched_mod.make_schedule(num_steps, kind="ddim")
+    # Always DDIM (`/root/reference/null_text.py:23` — the null-text path is
+    # DDIM-only), but β/α constants come from the backend's scheduler config.
+    schedule = sched_mod.schedule_from_config(num_steps, cfg.scheduler, kind="ddim")
     cond = encode_prompts(pipe, [prompt], dtype=dtype)
     uncond0 = encode_prompts(pipe, [""], dtype=dtype)
 

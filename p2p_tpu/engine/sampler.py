@@ -172,7 +172,7 @@ def text2image(
     *,
     num_steps: Optional[int] = None,
     guidance_scale: Optional[float] = None,
-    scheduler: str = "ddim",
+    scheduler: Optional[str] = None,
     latent: Optional[jax.Array] = None,
     rng: Optional[jax.Array] = None,
     uncond_embeddings: Optional[jax.Array] = None,
@@ -189,6 +189,7 @@ def text2image(
     """
     cfg = pipe.config
     num_steps = num_steps or cfg.num_steps
+    scheduler = scheduler or cfg.scheduler.kind
     if uncond_embeddings is not None:
         if scheduler != "ddim":
             # PLMS scans T+1 steps (warm-up double-evaluation); per-step
@@ -208,7 +209,8 @@ def text2image(
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    schedule = sched_mod.make_schedule(num_steps, kind=scheduler)
+    schedule = sched_mod.schedule_from_config(num_steps, cfg.scheduler,
+                                              kind=scheduler)
     context_cond = encode_prompts(pipe, prompts, dtype=dtype)
     context_uncond = encode_prompts(pipe, [""] * len(prompts), dtype=dtype)
 

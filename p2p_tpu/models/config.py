@@ -29,6 +29,9 @@ class UNetConfig:
     attn_levels: Tuple[bool, ...] = (True, True, True, False)
     layers_per_block: int = 2
     num_heads: int = 8
+    # When set, heads vary per level as channels // head_dim (LDM's fixed
+    # per-head width); when None, num_heads applies uniformly (SD).
+    head_dim: Optional[int] = None
     context_dim: int = 768                 # text-encoder hidden size
     context_len: int = 77
     transformer_depth: int = 1             # transformer blocks per attn site group
@@ -46,6 +49,12 @@ class UNetConfig:
 
     def resolution_at(self, level: int) -> int:
         return self.sample_size >> level
+
+    def heads_for(self, channels: int) -> int:
+        if self.head_dim is not None:
+            assert channels % self.head_dim == 0, (channels, self.head_dim)
+            return channels // self.head_dim
+        return self.num_heads
 
 
 SD14_UNET = UNetConfig()
@@ -81,7 +90,7 @@ def unet_attn_specs(cfg: UNetConfig):
 
     def site(place, level):
         res = cfg.resolution_at(level)
-        heads = cfg.num_heads
+        heads = cfg.heads_for(cfg.block_channels[level])
         for _ in range(cfg.transformer_depth):
             specs.append((place, False, res, heads, res * res))       # self
             specs.append((place, True, res, heads, cfg.context_len))  # cross
@@ -120,6 +129,17 @@ class TextEncoderConfig:
     ff_mult: int = 4
     activation: str = "quick_gelu"         # CLIP-L uses quick_gelu
     causal: bool = True
+    # Attention projection width (heads·head_dim). CLIP is square (None →
+    # hidden_dim); LDMBert projects 1280 → 8·64 = 512 and back.
+    attn_inner_dim: Optional[int] = None
+    # LDMBert's q/k/v projections carry no bias (out_proj does).
+    attn_qkv_bias: bool = True
+    # Checkpoint-name architecture: 'clip' (CLIPTextModel) | 'ldmbert'.
+    arch: str = "clip"
+
+    @property
+    def inner_dim(self) -> int:
+        return self.attn_inner_dim or self.hidden_dim
 
 SD14_TEXT = TextEncoderConfig()
 TINY_TEXT = TextEncoderConfig(vocab_size=49408, hidden_dim=32, num_layers=2,
@@ -128,7 +148,11 @@ TINY_TEXT = TextEncoderConfig(vocab_size=49408, hidden_dim=32, num_layers=2,
 
 @dataclasses.dataclass(frozen=True)
 class VAEConfig:
-    """KL autoencoder (diffusers `AutoencoderKL` topology)."""
+    """Latent autoencoder: KL (`AutoencoderKL`, SD) or VQ (`VQModel`, LDM).
+
+    ``kind='vq'`` adds a codebook: decode first snaps each latent vector to
+    its nearest codebook entry (the reference's `model.vqvae` decode path,
+    `/root/reference/ptp_utils.py:124`)."""
 
     in_channels: int = 3
     latent_channels: int = 4
@@ -137,10 +161,36 @@ class VAEConfig:
     layers_per_block: int = 2
     groups: int = 32
     scaling_factor: float = 0.18215        # `/root/reference/ptp_utils.py:80`
+    kind: str = "kl"                       # 'kl' | 'vq'
+    num_codebook: int = 16384              # VQ only: codebook entries
 
 SD14_VAE = VAEConfig()
 TINY_VAE = VAEConfig(base_channels=16, channel_mults=(1, 2, 2), layers_per_block=1,
                      groups=8)  # 2 downsamples: 64² image ⇄ 16² latent
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    """Scheduler constants, scoped per backend — the knobs the reference
+    scatters between pipeline defaults and explicit construction
+    (`/root/reference/main.py:29` keeps SD's pipeline PNDM;
+    `/root/reference/null_text.py:16-20` builds DDIM with clip_sample=False,
+    set_alpha_to_one=False)."""
+
+    kind: str = "ddim"                     # default sampler: 'ddim' | 'plms'
+    num_train_timesteps: int = 1000
+    beta_start: float = 0.00085
+    beta_end: float = 0.012
+    beta_schedule: str = "scaled_linear"
+    set_alpha_to_one: bool = False
+    clip_sample: bool = False
+    # The SD pipeline's PNDM config uses steps_offset=1 (every sampled
+    # timestep shifted up by one); the null-text DDIM construction leaves it 0.
+    plms_steps_offset: int = 1
+    ddim_steps_offset: int = 0
+
+    def steps_offset(self, kind: str) -> int:
+        return self.plms_steps_offset if kind == "plms" else self.ddim_steps_offset
 
 
 @dataclasses.dataclass(frozen=True)
@@ -154,6 +204,7 @@ class PipelineConfig:
     image_size: int = 512
     guidance_scale: float = 7.5            # `/root/reference/main.py:20`
     num_steps: int = 50
+    scheduler: SchedulerConfig = SchedulerConfig()
 
     @property
     def latent_size(self) -> int:
@@ -165,25 +216,59 @@ TINY = PipelineConfig("tiny", TINY_UNET, TINY_TEXT, TINY_VAE, image_size=64,
                       num_steps=4)
 
 # LDM text2im-large-256 (`/root/reference/ptp_utils.py:98-126`): BERT-style
-# (non-causal, gelu) 1280-d text encoder, 32² latent pyramid, VQ decoder
-# handled by the VAE stack with its own scaling. Attention heads: LDM uses
-# fixed head_dim 64 → heads vary per level; we keep uniform heads (a config
-# simplification that preserves shapes' head*dim products).
+# (non-causal, gelu) 1280-d text encoder tokenized by BERT wordpiece
+# (vocab 30522), 32² latent pyramid (256² image, f8 VQ autoencoder), heads at
+# fixed head_dim 64 (5/10/20 per level), VQ codebook decode. Structure follows
+# the CompVis txt2img-f8-large UNet: model_channels 320, mults (1,2,4,4),
+# 2 res blocks/level, attention at the 32²/16²/8² levels.
 LDM_UNET = UNetConfig(
     sample_size=32,
     in_channels=4,
     out_channels=4,
-    block_channels=(320, 640, 1280),
-    attn_levels=(True, True, True),
+    block_channels=(320, 640, 1280, 1280),
+    attn_levels=(True, True, True, False),
     layers_per_block=2,
-    num_heads=8,
+    head_dim=64,
     context_dim=1280,
     context_len=77,
 )
 LDM_TEXT = TextEncoderConfig(vocab_size=30522, hidden_dim=1280, num_layers=32,
                              num_heads=8, max_length=77, activation="gelu",
-                             causal=False)
-LDM_VAE = VAEConfig(base_channels=128, channel_mults=(1, 2, 4), latent_channels=4,
-                    scaling_factor=1.0)
+                             causal=False, attn_inner_dim=8 * 64,
+                             attn_qkv_bias=False, arch="ldmbert")
+# scaling_factor stays 0.18215: the reference decodes BOTH backends through
+# the same `latent2image` with the 1/0.18215 scale
+# (`/root/reference/ptp_utils.py:79-85`, VQ call at `:124`).
+# channel_mults (1,2,2,4) = 3 downsamples = f8 (the LDM VQ-f8 autoencoder):
+# 256² image ⇄ 32² latent, matching LDM_UNET.sample_size.
+LDM_VAE = VAEConfig(base_channels=128, channel_mults=(1, 2, 2, 4),
+                    latent_channels=4, kind="vq", num_codebook=16384)
 LDM256 = PipelineConfig("ldm-text2im-256", LDM_UNET, LDM_TEXT, LDM_VAE,
-                        image_size=256, guidance_scale=5.0, num_steps=50)
+                        image_size=256, guidance_scale=5.0, num_steps=50,
+                        scheduler=SchedulerConfig(
+                            beta_start=0.0015, beta_end=0.0195,
+                            plms_steps_offset=0))
+
+# High-resolution SD variant: same weights shapes, 128² latent (1024²
+# image). The 128²-pixel self-attention sites (16384² score matrix, ~2GB
+# per head in f32) are exactly the case ring/sequence-parallel attention
+# exists for — pass an SpConfig to apply_unet to shard them over a mesh.
+SD14_HR = PipelineConfig(
+    "sd-v1.4-1024", dataclasses.replace(SD14_UNET, sample_size=128),
+    SD14_TEXT, SD14_VAE, image_size=1024)
+
+# Tiny LDM-shaped backend for tests: same architectural family as LDM256
+# (per-level heads via head_dim, non-causal no-qkv-bias text encoder, VQ
+# decoder, LDM β schedule) at toy sizes.
+TINY_LDM_UNET = dataclasses.replace(
+    TINY_UNET, num_heads=1, head_dim=16, block_channels=(32, 64, 64))
+TINY_LDM_TEXT = dataclasses.replace(
+    TINY_TEXT, causal=False, activation="gelu", attn_inner_dim=32,
+    attn_qkv_bias=False, arch="ldmbert", vocab_size=30522)
+TINY_LDM_VAE = dataclasses.replace(TINY_VAE, kind="vq", num_codebook=64)
+TINY_LDM = PipelineConfig("tiny-ldm", TINY_LDM_UNET, TINY_LDM_TEXT,
+                          TINY_LDM_VAE, image_size=64, num_steps=4,
+                          guidance_scale=5.0,
+                          scheduler=SchedulerConfig(
+                              beta_start=0.0015, beta_end=0.0195,
+                              plms_steps_offset=0))

@@ -58,6 +58,10 @@ class DiffusionSchedule:
     final_alpha_cumprod: jax.Array       # scalar
     num_train_timesteps: int = struct.field(pytree_node=False, default=1000)
     num_inference_steps: int = struct.field(pytree_node=False, default=50)
+    # Diffusers `clip_sample`: clamp pred_x0 to [-1, 1] inside the DDIM update.
+    # False for both reference-relevant configs (SD DDIM sets it explicitly,
+    # `/root/reference/null_text.py:19`); static so it costs nothing when off.
+    clip_sample: bool = struct.field(pytree_node=False, default=False)
 
     @property
     def step_size(self) -> int:
@@ -73,6 +77,7 @@ def make_schedule(
     set_alpha_to_one: bool = False,
     steps_offset: int = 0,
     kind: str = "ddim",
+    clip_sample: bool = False,
     dtype=jnp.float32,
 ) -> DiffusionSchedule:
     """Build a :class:`DiffusionSchedule`.
@@ -100,6 +105,27 @@ def make_schedule(
         final_alpha_cumprod=jnp.asarray(final, dtype=dtype),
         num_train_timesteps=num_train_timesteps,
         num_inference_steps=num_inference_steps,
+        clip_sample=clip_sample,
+    )
+
+
+def schedule_from_config(num_inference_steps: int, sched_cfg, kind: Optional[str] = None,
+                         dtype=jnp.float32) -> DiffusionSchedule:
+    """Build the schedule a backend's :class:`SchedulerConfig` describes,
+    optionally overriding the sampler kind (the reference uses PNDM for the
+    CLI path and DDIM for null-text on the same SD backend)."""
+    kind = kind or sched_cfg.kind
+    return make_schedule(
+        num_inference_steps,
+        num_train_timesteps=sched_cfg.num_train_timesteps,
+        beta_start=sched_cfg.beta_start,
+        beta_end=sched_cfg.beta_end,
+        schedule=sched_cfg.beta_schedule,
+        set_alpha_to_one=sched_cfg.set_alpha_to_one,
+        steps_offset=sched_cfg.steps_offset(kind),
+        kind=kind,
+        clip_sample=sched_cfg.clip_sample,
+        dtype=dtype,
     )
 
 
@@ -126,6 +152,10 @@ def ddim_step(
     x = sample.astype(jnp.float32)
     e = eps.astype(jnp.float32)
     pred_x0 = (x - jnp.sqrt(1.0 - a_t) * e) / jnp.sqrt(a_t)
+    if sched.clip_sample:
+        # diffusers 0.8.1 semantics (the reference's pin): clamp pred_x0 but
+        # keep the raw ε in the direction term — no ε recompute.
+        pred_x0 = jnp.clip(pred_x0, -1.0, 1.0)
     direction = jnp.sqrt(1.0 - a_prev) * e
     # Step math in f32 regardless of compute dtype (the constants span 4
     # orders of magnitude); carry dtype is preserved for the scan.

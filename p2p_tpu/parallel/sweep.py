@@ -75,7 +75,8 @@ def sweep(
     if layout is None:
         from ..models.config import unet_layout
         layout = unet_layout(cfg.unet)
-    schedule = sched_mod.make_schedule(num_steps, kind=scheduler)
+    schedule = sched_mod.schedule_from_config(num_steps, cfg.scheduler,
+                                              kind=scheduler)
     gs = jnp.asarray(guidance_scale, jnp.float32)
 
     if mesh is not None:

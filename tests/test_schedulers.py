@@ -7,6 +7,7 @@ import numpy as np
 
 from p2p_tpu.ops.schedulers import (
     DiffusionSchedule,
+    schedule_from_config,
     add_noise,
     ddim_next_step,
     ddim_step,
@@ -216,3 +217,61 @@ def test_add_noise_interpolates():
     n = jnp.zeros_like(x0)
     out = add_noise(s, x0, n, jnp.int32(0))
     np.testing.assert_allclose(np.asarray(out), np.sqrt(np.asarray(s.alphas_cumprod[0])), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# SchedulerConfig parity (VERDICT r1 item 5): the constants diffusers' SD
+# PNDM / DDIM configs produce, hand-derived from their documented formulas
+# (`/root/reference/main.py:29` pipeline PNDM has steps_offset=1;
+# `/root/reference/null_text.py:16-20` DDIM has offset 0, clip_sample=False).
+# ---------------------------------------------------------------------------
+
+
+def test_sd_plms_schedule_has_steps_offset_1():
+    from p2p_tpu.models.config import SD14
+
+    s = schedule_from_config(50, SD14.scheduler, kind="plms")
+    ts = np.asarray(s.timesteps)
+    # diffusers PNDM (skip_prk): base = arange(50)*20 + 1; plms layout
+    # duplicates the second-highest then reverses -> [981, 961, 961, 941, ...]
+    base = np.arange(50) * 20 + 1
+    want = np.concatenate([base[:-1], base[-2:-1], base[-1:]])[::-1]
+    assert ts.tolist() == want.tolist()
+    assert ts[0] == 981 and ts[1] == 961 and ts[2] == 961 and ts[-1] == 1
+
+
+def test_sd_ddim_schedule_has_steps_offset_0():
+    from p2p_tpu.models.config import SD14
+
+    s = schedule_from_config(50, SD14.scheduler, kind="ddim")
+    ts = np.asarray(s.timesteps)
+    assert ts.tolist() == list(range(980, -1, -20))
+    assert not s.clip_sample
+    # set_alpha_to_one=False: final alpha is alphas_cumprod[0] = 1 - 0.00085.
+    np.testing.assert_allclose(float(s.final_alpha_cumprod), 1.0 - 0.00085,
+                               rtol=1e-6)
+
+
+def test_ldm_schedule_constants():
+    from p2p_tpu.models.config import LDM256
+
+    s = schedule_from_config(50, LDM256.scheduler, kind="ddim")
+    betas = make_betas(1000, LDM256.scheduler.beta_start,
+                             LDM256.scheduler.beta_end)
+    np.testing.assert_allclose(betas[0], 0.0015, rtol=1e-6)
+    np.testing.assert_allclose(betas[-1], 0.0195, rtol=1e-6)
+    np.testing.assert_allclose(float(s.alphas_cumprod[0]), 1 - 0.0015, rtol=1e-6)
+
+
+def test_clip_sample_clamps_pred_x0():
+    s = make_schedule(10, clip_sample=True)
+    s_off = make_schedule(10, clip_sample=False)
+    x = jnp.full((1, 2, 2, 1), 30.0)  # huge sample -> pred_x0 way outside [-1,1]
+    eps = jnp.zeros_like(x)
+    t = s.timesteps[0]
+    on = np.asarray(ddim_step(s, eps, t, x))
+    off = np.asarray(ddim_step(s_off, eps, t, x))
+    a_prev = float(s.alphas_cumprod[int(t) - s.step_size])
+    # with eps=0 and clipping, the update is exactly sqrt(a_prev) * 1.0
+    np.testing.assert_allclose(on, np.sqrt(a_prev), rtol=1e-5)
+    assert np.all(off > 10.0)
