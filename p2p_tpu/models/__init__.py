@@ -9,6 +9,8 @@ the prompt-to-prompt controller provably never looks.
 
 from .config import (
     LDM256,
+    TINY_LDM,
+    SD14_HR,
     SD14,
     TINY,
     PipelineConfig,
@@ -23,7 +25,7 @@ from .unet import apply_unet, init_unet
 from . import vae
 
 __all__ = [
-    "LDM256", "SD14", "TINY",
+    "LDM256", "SD14", "SD14_HR", "TINY", "TINY_LDM",
     "PipelineConfig", "TextEncoderConfig", "UNetConfig", "VAEConfig",
     "unet_attn_specs", "unet_layout",
     "apply_text_encoder", "init_text_encoder",

@@ -170,6 +170,32 @@ def text_encoder_entries(cfg: TextEncoderConfig) -> List[Entry]:
     return e
 
 
+def ldm_text_encoder_entries(cfg: TextEncoderConfig) -> List[Entry]:
+    """diffusers ``LDMBertModel`` names (the `model.bert` the reference's LDM
+    path encodes with, `/root/reference/ptp_utils.py:113`): pre-norm encoder
+    layers under ``model.layers.N``, learned position embeddings, final
+    ``model.layer_norm``. The unused ``to_logits`` head is ignored on load."""
+    e: List[Entry] = [
+        (("token_embed",), "model.embed_tokens.weight", "none"),
+        (("pos_embed",), "model.embed_positions.weight", "none"),
+    ]
+    for i in range(cfg.num_layers):
+        base = f"model.layers.{i}"
+        e += _norm(("layers", i, "ln1"), base + ".self_attn_layer_norm")
+        e += _lin(("layers", i, "q"), base + ".self_attn.q_proj",
+                  bias=cfg.attn_qkv_bias)
+        e += _lin(("layers", i, "k"), base + ".self_attn.k_proj",
+                  bias=cfg.attn_qkv_bias)
+        e += _lin(("layers", i, "v"), base + ".self_attn.v_proj",
+                  bias=cfg.attn_qkv_bias)
+        e += _lin(("layers", i, "out"), base + ".self_attn.out_proj")
+        e += _norm(("layers", i, "ln2"), base + ".final_layer_norm")
+        e += _lin(("layers", i, "fc1"), base + ".fc1")
+        e += _lin(("layers", i, "fc2"), base + ".fc2")
+    e += _norm(("final_ln",), "model.layer_norm")
+    return e
+
+
 def _vae_attn(our, their) -> List[Entry]:
     return (_norm(our + ("norm",), their + ".group_norm")
             + _lin(our + ("q",), their + ".query")
@@ -203,6 +229,9 @@ def vae_entries(cfg: VAEConfig) -> List[Entry]:
     e += _norm(("encoder", "norm_out"), "encoder.conv_norm_out")
     e += _conv(("encoder", "conv_out"), "encoder.conv_out")
     e += _conv(("encoder", "quant_conv"), "quant_conv")
+    if cfg.kind == "vq":
+        # diffusers VQModel keeps the codebook at quantize.embedding.
+        e.append((("codebook",), "quantize.embedding.weight", "none"))
 
     e += _conv(("decoder", "post_quant_conv"), "post_quant_conv")
     e += _conv(("decoder", "conv_in"), "decoder.conv_in")
@@ -287,7 +316,8 @@ def apply_state_dict(params: Any, entries: List[Entry],
             raise KeyError(f"checkpoint missing {len(missing)} entries, "
                            f"first: {missing[:5]}")
         unused = [k for k in sd if k not in used
-                  and not k.endswith("position_ids")]
+                  and not k.endswith("position_ids")
+                  and not k.startswith("to_logits")]
         if unused:
             raise KeyError(f"checkpoint has {len(unused)} unmapped entries, "
                            f"first: {unused[:5]}")
@@ -313,7 +343,9 @@ def load_text_encoder(params: Any, cfg: TextEncoderConfig, dirpath: str,
                       strict: bool = True) -> Any:
     sd = read_state_dict(_find_weights_file(
         dirpath, ("model.safetensors", "pytorch_model.bin")))
-    return apply_state_dict(params, text_encoder_entries(cfg), sd, strict)
+    entries = (ldm_text_encoder_entries(cfg) if cfg.arch == "ldmbert"
+               else text_encoder_entries(cfg))
+    return apply_state_dict(params, entries, sd, strict)
 
 
 def load_vae(params: Any, cfg: VAEConfig, dirpath: str, strict: bool = True) -> Any:
@@ -341,7 +373,13 @@ def load_pipeline(checkpoint_dir: str, config, tokenizer=None):
     vae_params = load_vae(vae_mod.init_vae(jax.random.PRNGKey(0), config.vae),
                           config.vae, os.path.join(checkpoint_dir, "vae"))
     if tokenizer is None:
-        tokenizer = ClipBpeTokenizer.from_dir(os.path.join(checkpoint_dir, "tokenizer"))
+        tok_dir = os.path.join(checkpoint_dir, "tokenizer")
+        if config.text.arch == "ldmbert":
+            from ..utils.tokenizer import BertWordPieceTokenizer
+
+            tokenizer = BertWordPieceTokenizer.from_dir(tok_dir)
+        else:
+            tokenizer = ClipBpeTokenizer.from_dir(tok_dir)
     return Pipeline(config=config, unet_params=unet_params,
                     text_params=text_params, vae_params=vae_params,
                     tokenizer=tokenizer)

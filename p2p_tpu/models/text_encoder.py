@@ -30,14 +30,15 @@ def init_text_encoder(key: jax.Array, cfg: TextEncoderConfig) -> Params:
         "layers": [],
         "final_ln": nn.norm_init(d),
     }
+    inner = cfg.inner_dim
     for _ in range(cfg.num_layers):
         k1, k2, k3, k4, k5, k6 = jax.random.split(next(keys), 6)
         params["layers"].append({
             "ln1": nn.norm_init(d),
-            "q": nn.linear_init(k1, d, d),
-            "k": nn.linear_init(k2, d, d),
-            "v": nn.linear_init(k3, d, d),
-            "out": nn.linear_init(k4, d, d),
+            "q": nn.linear_init(k1, d, inner, bias=cfg.attn_qkv_bias),
+            "k": nn.linear_init(k2, d, inner, bias=cfg.attn_qkv_bias),
+            "v": nn.linear_init(k3, d, inner, bias=cfg.attn_qkv_bias),
+            "out": nn.linear_init(k4, inner, d),
             "ln2": nn.norm_init(d),
             "fc1": nn.linear_init(k5, d, d * cfg.ff_mult),
             "fc2": nn.linear_init(k6, d * cfg.ff_mult, d),
@@ -59,7 +60,7 @@ def apply_text_encoder(params: Params, cfg: TextEncoderConfig,
         mask = mask[None, None]
 
     heads = cfg.num_heads
-    d_head = cfg.hidden_dim // heads
+    d_head = cfg.inner_dim // heads
     scale = d_head ** -0.5
 
     def split_heads(t):
@@ -71,7 +72,7 @@ def apply_text_encoder(params: Params, cfg: TextEncoderConfig,
         k = split_heads(nn.linear(layer["k"], h))
         v = split_heads(nn.linear(layer["v"], h))
         attn = nn.fused_attention(q, k, v, scale, mask)
-        attn = attn.transpose(0, 2, 1, 3).reshape(b, length, cfg.hidden_dim)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, length, cfg.inner_dim)
         x = x + nn.linear(layer["out"], attn)
 
         h = nn.layer_norm(layer["ln2"], x)

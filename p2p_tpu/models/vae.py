@@ -89,8 +89,13 @@ def init_vae(key: jax.Array, cfg: VAEConfig) -> Params:
         "resnet2": _resnet_init(next(keys), top, top),
     }
     enc["norm_out"] = nn.norm_init(top)
-    enc["conv_out"] = nn.conv_init(next(keys), top, 2 * lat)   # mean ‖ logvar
-    enc["quant_conv"] = nn.conv_init(next(keys), 2 * lat, 2 * lat, kernel=1)
+    if cfg.kind == "vq":
+        # VQ encoder emits the embedding directly; KL emits mean ‖ logvar.
+        enc["conv_out"] = nn.conv_init(next(keys), top, lat)
+        enc["quant_conv"] = nn.conv_init(next(keys), lat, lat, kernel=1)
+    else:
+        enc["conv_out"] = nn.conv_init(next(keys), top, 2 * lat)
+        enc["quant_conv"] = nn.conv_init(next(keys), 2 * lat, 2 * lat, kernel=1)
 
     dec: Params = {
         "post_quant_conv": nn.conv_init(next(keys), lat, lat, kernel=1),
@@ -115,13 +120,19 @@ def init_vae(key: jax.Array, cfg: VAEConfig) -> Params:
     dec["norm_out"] = nn.norm_init(chs[0])
     dec["conv_out"] = nn.conv_init(next(keys), chs[0], cfg.in_channels)
 
-    return {"encoder": enc, "decoder": dec}
+    params = {"encoder": enc, "decoder": dec}
+    if cfg.kind == "vq":
+        params["codebook"] = (jax.random.uniform(
+            next(keys), (cfg.num_codebook, lat), jnp.float32,
+            -1.0 / cfg.num_codebook, 1.0 / cfg.num_codebook))
+    return params
 
 
-def encode_moments(params: Params, cfg: VAEConfig, image: jax.Array
-                   ) -> Tuple[jax.Array, jax.Array]:
-    """image (B,H,W,3) in [-1,1] → posterior (mean, logvar), each
-    (B, H/8, W/8, latent_channels) for the SD VAE's 3 downsamples."""
+def _encoder_trunk(params: Params, cfg: VAEConfig, image: jax.Array) -> jax.Array:
+    """Shared encoder body through quant_conv: conv_in → down blocks (with
+    diffusers' asymmetric (0,1)/(0,1) pad before each stride-2 conv) → mid →
+    norm/conv_out → quant_conv. KL and VQ differ only in what the output
+    means (mean‖logvar vs embedding)."""
     p = params["encoder"]
     g = cfg.groups
     h = nn.conv2d(p["conv_in"], image)
@@ -129,31 +140,60 @@ def encode_moments(params: Params, cfg: VAEConfig, image: jax.Array
         for resnet in block["resnets"]:
             h = _apply_resnet(resnet, h, g)
         if "downsample" in block:
-            # diffusers pads (0,1)/(0,1) before the stride-2 conv.
             h = jnp.pad(h, ((0, 0), (0, 1), (0, 1), (0, 0)))
             h = nn.conv2d(block["downsample"], h, stride=2, padding="VALID")
     h = _apply_resnet(p["mid"]["resnet1"], h, g)
     h = _apply_attn(p["mid"]["attn"], h, g)
     h = _apply_resnet(p["mid"]["resnet2"], h, g)
     h = nn.conv2d(p["conv_out"], nn.silu(nn.group_norm(p["norm_out"], h, g)))
-    moments = nn.conv2d(p["quant_conv"], h)
+    return nn.conv2d(p["quant_conv"], h)
+
+
+def encode_moments(params: Params, cfg: VAEConfig, image: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """image (B,H,W,3) in [-1,1] → posterior (mean, logvar), each
+    (B, H/8, W/8, latent_channels) for the SD VAE's 3 downsamples."""
+    moments = _encoder_trunk(params, cfg, image)
     mean, logvar = jnp.split(moments, 2, axis=-1)
     return mean, jnp.clip(logvar, -30.0, 20.0)
 
 
 def encode(params: Params, cfg: VAEConfig, image: jax.Array) -> jax.Array:
     """Deterministic latent: scaled posterior mean
-    (`/root/reference/null_text.py:527` uses ``.mean * 0.18215``)."""
+    (`/root/reference/null_text.py:527` uses ``.mean * 0.18215``).
+    For VQ the encoder output is the (pre-quantization) embedding."""
+    if cfg.kind == "vq":
+        return _encoder_trunk(params, cfg, image) * cfg.scaling_factor
     mean, _ = encode_moments(params, cfg, image)
     return mean * cfg.scaling_factor
 
 
+def quantize(params: Params, cfg: VAEConfig, z: jax.Array) -> jax.Array:
+    """Snap each latent vector to its nearest codebook entry (L2) — the VQ
+    lookup diffusers' ``VQModel.decode`` performs before decoding. Distances
+    expand to z·z − 2 z·e + e·e so the hot op is one (pixels, lat)×(lat, K)
+    matmul; the argmin gather is trivially small."""
+    cb = params["codebook"].astype(jnp.float32)           # (K, C)
+    zf = z.astype(jnp.float32)
+    flat = zf.reshape(-1, zf.shape[-1])                   # (P, C)
+    d = (jnp.sum(flat * flat, axis=1, keepdims=True)
+         - 2.0 * flat @ cb.T
+         + jnp.sum(cb * cb, axis=1)[None])
+    idx = jnp.argmin(d, axis=1)
+    return cb[idx].reshape(z.shape).astype(z.dtype)
+
+
 def decode(params: Params, cfg: VAEConfig, latents: jax.Array) -> jax.Array:
     """latents (B,h,w,4) → image (B,H,W,3) in [-1,1]
-    (`/root/reference/ptp_utils.py:79-84`: input scaled by 1/0.18215)."""
+    (`/root/reference/ptp_utils.py:79-84`: input scaled by 1/0.18215 — the
+    reference routes BOTH the SD KL-VAE and the LDM VQ decode through this
+    same function, `/root/reference/ptp_utils.py:124`)."""
     p = params["decoder"]
     g = cfg.groups
-    h = nn.conv2d(p["post_quant_conv"], latents / cfg.scaling_factor)
+    h = latents / cfg.scaling_factor
+    if cfg.kind == "vq":
+        h = quantize(params, cfg, h)
+    h = nn.conv2d(p["post_quant_conv"], h)
     h = nn.conv2d(p["conv_in"], h)
     h = _apply_resnet(p["mid"]["resnet1"], h, g)
     h = _apply_attn(p["mid"]["attn"], h, g)
