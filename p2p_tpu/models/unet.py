@@ -19,6 +19,7 @@ All tensors NHWC; params f32; compute dtype is the caller's (`x.dtype`).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -171,20 +172,37 @@ def _apply_resnet(p: Params, x: jax.Array, temb: jax.Array, groups: int) -> jax.
 
 class _HookCtx:
     """Trace-time cursor over the attention layout, carrying the controller
-    store state through the sites in call order."""
+    store state through the sites in call order. ``sp`` optionally names a
+    mesh axis for sequence-parallel (ring) self-attention at large sites."""
 
     def __init__(self, layout: AttnLayout, controller: Optional[Controller],
-                 state: StoreState, step: jax.Array):
+                 state: StoreState, step: jax.Array,
+                 sp: Optional["SpConfig"] = None):
         self.layout = layout
         self.controller = controller
         self.state = state
         self.step = step
+        self.sp = sp
         self.cursor = 0
 
     def next_meta(self):
         meta = self.layout.metas[self.cursor]
         self.cursor += 1
         return meta
+
+
+@dataclasses.dataclass(frozen=True)
+class SpConfig:
+    """Sequence-parallel plan for self-attention: shard the pixel axis of
+    every *untouched* self site with ≥ ``min_pixels`` pixels over mesh axis
+    ``axis`` and attend with ring communication (`parallel/ring.py`). This is
+    the scaling axis the reference lacks entirely (SURVEY §5: resolution is
+    quadratic in pixels); controller-touched sites stay local because edits
+    read whole probability rows."""
+
+    mesh: Any                 # jax.sharding.Mesh
+    axis: str = "sp"
+    min_pixels: int = 64 * 64
 
 
 def _apply_attention(p: Params, x: jax.Array, context: jax.Array, heads: int,
@@ -213,6 +231,12 @@ def _apply_attention(p: Params, x: jax.Array, context: jax.Array, heads: int,
         ctx.state, probs = apply_attention_control(
             ctx.controller, meta, ctx.state, probs, ctx.step)
         out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+    elif (ctx.sp is not None and not is_cross
+          and meta.pixels >= ctx.sp.min_pixels
+          and meta.pixels % ctx.sp.mesh.shape[ctx.sp.axis] == 0):
+        from ..parallel.ring import ring_self_attention
+
+        out = ring_self_attention(q, k, v, scale, ctx.sp.mesh, ctx.sp.axis)
     else:
         out = nn.fused_attention(q, k, v, scale)
 
@@ -243,7 +267,7 @@ def _apply_spatial_transformer(p: Params, x: jax.Array, context: jax.Array,
     x = x.reshape(b, h * w, c)
     x = nn.linear_1x1(p["proj_in"], x)
     for block in p["blocks"]:
-        x = _apply_transformer_block(block, x, context, cfg.num_heads, ctx)
+        x = _apply_transformer_block(block, x, context, cfg.heads_for(c), ctx)
     x = nn.linear_1x1(p["proj_out"], x)
     return x.reshape(b, h, w, c) + residual
 
@@ -258,18 +282,20 @@ def apply_unet(
     controller: Optional[Controller] = None,
     state: StoreState = (),
     step: Optional[jax.Array] = None,
+    sp: Optional[SpConfig] = None,
 ) -> Tuple[jax.Array, StoreState]:
     """Predict ε(x_t, t, context). Returns ``(eps, controller_store_state)``.
 
     With ``controller=None`` this is a plain conditional U-Net forward and the
     returned state is the input state — the `EmptyControl ≡ no controller`
-    equivalence holds at the XLA-program level.
+    equivalence holds at the XLA-program level. ``sp`` enables ring
+    (sequence-parallel) attention for large untouched self sites.
     """
     if layout is None:
         layout = unet_layout(cfg)
     if step is None:
         step = jnp.int32(0)
-    ctx = _HookCtx(layout, controller, state, step)
+    ctx = _HookCtx(layout, controller, state, step, sp=sp)
     g = cfg.groups
 
     t = jnp.broadcast_to(jnp.asarray(t), (x.shape[0],))
