@@ -98,17 +98,22 @@ def cmd_generate(args) -> int:
 
     from .engine.sampler import text2image
 
+    from .utils.progress import trace
+
     pipe = _build_pipeline(args)
-    for seed in args.seeds:
-        img, _, _ = text2image(pipe, [args.prompt], None, num_steps=args.steps,
-                               guidance_scale=args.guidance,
-                               scheduler=args.scheduler,
-                               rng=jax.random.PRNGKey(seed))
-        out = args.out
-        if len(args.seeds) > 1:
-            root, ext = os.path.splitext(out)
-            out = f"{root}_{seed:05d}{ext}"
-        _save(np.asarray(img[0]), out)
+    with trace(args.profile):
+        for seed in args.seeds:
+            img, _, _ = text2image(pipe, [args.prompt], None,
+                                   num_steps=args.steps,
+                                   guidance_scale=args.guidance,
+                                   scheduler=args.scheduler,
+                                   rng=jax.random.PRNGKey(seed),
+                                   progress=not args.quiet)
+            out = args.out
+            if len(args.seeds) > 1:
+                root, ext = os.path.splitext(out)
+                out = f"{root}_{seed:05d}{ext}"
+            _save(np.asarray(img[0]), out)
     return 0
 
 
@@ -117,32 +122,45 @@ def cmd_edit(args) -> int:
 
     from .engine.sampler import text2image
 
+    from .utils.progress import trace
+
     pipe = _build_pipeline(args)
     prompts = [args.source, args.target]
     controller = _make_controller(args, prompts, pipe.tokenizer, args.steps)
     out_dir = args.out_dir or os.path.join("logs", time.strftime("%y%m%d_%H%M%S"))
-    for seed in args.seeds:
-        rng = jax.random.PRNGKey(seed)
-        base, x_t, _ = text2image(pipe, prompts, None, num_steps=args.steps,
-                                  guidance_scale=args.guidance,
-                                  scheduler=args.scheduler, rng=rng)
-        img, _, _ = text2image(pipe, prompts, controller, num_steps=args.steps,
-                               guidance_scale=args.guidance,
-                               scheduler=args.scheduler, latent=x_t)
-        # y / y_hat naming per `/root/reference/main.py:375-380,435-444`.
-        _save(np.asarray(base[0]), os.path.join(out_dir, f"{seed:05d}_y.jpg"))
-        _save(np.asarray(img[1]), os.path.join(out_dir, f"{seed:05d}_y_hat.jpg"))
+    with trace(args.profile):
+        for seed in args.seeds:
+            rng = jax.random.PRNGKey(seed)
+            base, x_t, _ = text2image(pipe, prompts, None,
+                                      num_steps=args.steps,
+                                      guidance_scale=args.guidance,
+                                      scheduler=args.scheduler, rng=rng,
+                                      progress=not args.quiet)
+            img, _, _ = text2image(pipe, prompts, controller,
+                                   num_steps=args.steps,
+                                   guidance_scale=args.guidance,
+                                   scheduler=args.scheduler, latent=x_t,
+                                   progress=not args.quiet)
+            # y / y_hat naming per `/root/reference/main.py:375-380,435-444`.
+            _save(np.asarray(base[0]),
+                  os.path.join(out_dir, f"{seed:05d}_y.jpg"))
+            _save(np.asarray(img[1]),
+                  os.path.join(out_dir, f"{seed:05d}_y_hat.jpg"))
     return 0
 
 
 def cmd_invert(args) -> int:
     from .engine.inversion import invert, load_image
 
+    from .utils.progress import trace
+
     pipe = _build_pipeline(args)
     image = load_image(args.image, size=pipe.config.image_size)
-    art = invert(pipe, image, args.prompt, num_steps=args.steps,
-                 guidance_scale=args.guidance,
-                 num_inner_steps=args.inner_steps)
+    with trace(args.profile):
+        art = invert(pipe, image, args.prompt, num_steps=args.steps,
+                     guidance_scale=args.guidance,
+                     num_inner_steps=args.inner_steps,
+                     progress=not args.quiet)
     art.save(args.artifact)
     print(f"wrote {args.artifact}")
     if args.out_dir:
@@ -162,10 +180,14 @@ def cmd_replay(args) -> int:
     prompts = [art.prompt, args.target] if args.target else [art.prompt]
     controller = (None if len(prompts) == 1 else
                   _make_controller(args, prompts, pipe.tokenizer, art.num_steps))
-    img, _, _ = text2image(
-        pipe, prompts, controller, num_steps=art.num_steps,
-        guidance_scale=args.guidance, latent=jnp.asarray(art.x_t),
-        uncond_embeddings=jnp.asarray(art.uncond_embeddings))
+    from .utils.progress import trace
+
+    with trace(args.profile):
+        img, _, _ = text2image(
+            pipe, prompts, controller, num_steps=art.num_steps,
+            guidance_scale=args.guidance, latent=jnp.asarray(art.x_t),
+            uncond_embeddings=jnp.asarray(art.uncond_embeddings),
+            progress=not args.quiet)
     out_dir = args.out_dir or "outputs"
     _save(np.asarray(img[0]), os.path.join(out_dir, "reconstruction.png"))
     if len(prompts) > 1:
@@ -191,6 +213,10 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--checkpoint", default=None,
                         help="diffusers-format checkpoint dir (unet/ vae/ ...)")
         sp.add_argument("--guidance", type=float, default=7.5)
+        sp.add_argument("--quiet", action="store_true",
+                        help="suppress per-step progress output")
+        sp.add_argument("--profile", default=None, metavar="DIR",
+                        help="write a jax.profiler trace of the run to DIR")
 
     def sampling_opts(sp):
         sp.add_argument("--steps", type=int, default=50)

@@ -32,6 +32,7 @@ from ..models import vae as vae_mod
 from ..models.config import PipelineConfig
 from ..models.unet import apply_unet
 from ..ops import schedulers as sched_mod
+from ..utils import progress as progress_mod
 from .sampler import Pipeline, encode_prompts
 
 
@@ -90,10 +91,11 @@ def load_image(path: str, size: int = 512, left: int = 0, right: int = 0,
     return img
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg", "progress"))
 def _ddim_invert_jit(unet_params, vae_params, cfg: PipelineConfig,
                      schedule: sched_mod.DiffusionSchedule,
-                     image: jax.Array, cond: jax.Array):
+                     image: jax.Array, cond: jax.Array,
+                     progress: bool = False):
     """image (1,H,W,3) in [-1,1] → all T+1 latents, ascending noise."""
     latent0 = vae_mod.encode(vae_params, cfg.vae, image)
 
@@ -101,12 +103,15 @@ def _ddim_invert_jit(unet_params, vae_params, cfg: PipelineConfig,
     # (`/root/reference/null_text.py:555-560` uses timesteps[-(i+1)]).
     ts = schedule.timesteps[::-1]
 
-    def body(latent, t):
+    def body(latent, scan_in):
+        i, t = scan_in
+        progress_mod.emit_step(progress, i)
         eps, _ = apply_unet(unet_params, cfg.unet, latent, t, cond)
         nxt = sched_mod.ddim_next_step(schedule, eps, t, latent)
         return nxt, nxt
 
-    x_t, all_latents = jax.lax.scan(body, latent0, ts)
+    idx = jnp.arange(ts.shape[0], dtype=jnp.int32)
+    x_t, all_latents = jax.lax.scan(body, latent0, (idx, ts))
     return latent0, x_t, jnp.concatenate([latent0[None], all_latents], axis=0)
 
 
@@ -120,7 +125,7 @@ def _adam_update(g, m, v, j, lr, b1=0.9, b2=0.999, eps=1e-8):
     return -lr * mhat / (jnp.sqrt(vhat) + eps), m, v
 
 
-@partial(jax.jit, static_argnames=("cfg", "num_inner_steps"))
+@partial(jax.jit, static_argnames=("cfg", "num_inner_steps", "progress"))
 def _null_optimize_jit(unet_params, cfg: PipelineConfig,
                        schedule: sched_mod.DiffusionSchedule,
                        latents: jax.Array,        # (T+1, 1, h, w, c) ascending
@@ -128,7 +133,8 @@ def _null_optimize_jit(unet_params, cfg: PipelineConfig,
                        cond: jax.Array,           # (1, L, D) prompt embedding
                        guidance_scale: jax.Array,
                        num_inner_steps: int,
-                       epsilon: jax.Array):
+                       epsilon: jax.Array,
+                       progress: bool = False):
     """Per-timestep uncond-embedding optimization
     (`/root/reference/null_text.py:574-606`). Returns (T, 1, L, D)."""
     t_count = schedule.timesteps.shape[0]
@@ -136,7 +142,12 @@ def _null_optimize_jit(unet_params, cfg: PipelineConfig,
     def outer(carry, scan_in):
         latent_cur, uncond = carry
         i, t = scan_in
-        lr = 0.01 * (1.0 - i.astype(jnp.float32) / 100.0)
+        progress_mod.emit_step(progress, i)
+        # Reference decay is the literal `1e-2 * (1 - i/100)` at T=50
+        # (`/root/reference/null_text.py:582`) — i.e. lr halves over the run.
+        # Generalized as i/(2T): identical numbers at T=50, and the schedule
+        # stays positive/meaningful for any other step count.
+        lr = 0.01 * (1.0 - i.astype(jnp.float32) / (2.0 * t_count))
         stop_at = epsilon + i.astype(jnp.float32) * 2e-5
         # Target: the recorded inversion latent one step less noisy
         # (`/root/reference/null_text.py:584` latents[len - i - 2]).
@@ -192,6 +203,7 @@ def invert(
     num_inner_steps: int = 10,
     early_stop_epsilon: float = 1e-5,
     dtype=jnp.float32,
+    progress: bool = False,
 ) -> InversionArtifact:
     """Full null-text inversion (`/root/reference/null_text.py:608-618`):
     DDIM-invert with guidance 1, then optimize per-step uncond embeddings so
@@ -213,12 +225,21 @@ def invert(
     cond = encode_prompts(pipe, [prompt], dtype=dtype)
     uncond0 = encode_prompts(pipe, [""], dtype=dtype)
 
+    if progress:
+        progress_mod.set_active(
+            progress_mod.StepReporter(num_steps, "ddim-invert"))
     latent0, x_t, all_latents = _ddim_invert_jit(
-        pipe.unet_params, pipe.vae_params, cfg, schedule, image_j, cond)
+        pipe.unet_params, pipe.vae_params, cfg, schedule, image_j, cond,
+        progress=progress)
 
+    if progress:
+        jax.effects_barrier()  # drain phase-1 callbacks (block_until_ready
+        # only waits on the computation, not on host callback delivery)
+        progress_mod.set_active(
+            progress_mod.StepReporter(num_steps, "null-text opt"))
     uncond_list = _null_optimize_jit(
         pipe.unet_params, cfg, schedule, all_latents, uncond0, cond, gs,
-        num_inner_steps, jnp.float32(early_stop_epsilon))
+        num_inner_steps, jnp.float32(early_stop_epsilon), progress=progress)
 
     rec = vae_mod.to_uint8(vae_mod.decode(
         pipe.vae_params, cfg.vae, latent0.astype(jnp.float32)))

@@ -42,6 +42,7 @@ from ..models.config import PipelineConfig
 from ..models.text_encoder import apply_text_encoder
 from ..models.unet import apply_unet
 from ..ops import schedulers as sched_mod
+from ..utils import progress as progress_mod
 from ..utils.tokenizer import Tokenizer, pad_ids
 
 
@@ -101,6 +102,7 @@ def _denoise_scan(
     controller: Optional[Controller],
     guidance_scale: jax.Array,
     uncond_per_step: Optional[jax.Array] = None,  # (T, 1, L, D) null-text embeddings
+    progress: bool = False,
 ) -> Tuple[jax.Array, StoreState]:
     """Scan over timesteps. Returns (final latents, final store state)."""
     b = latents.shape[0]
@@ -114,6 +116,7 @@ def _denoise_scan(
     def body(carry, scan_in):
         latents, state, plms = carry
         step, t = scan_in
+        progress_mod.emit_step(progress, step)
         ctx = context
         if uncond_per_step is not None:
             # Null-text: substitute this step's optimized uncond embedding.
@@ -140,7 +143,7 @@ def _denoise_scan(
 
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
-                                   "return_store"))
+                                   "return_store", "progress"))
 def _text2image_jit(
     unet_params: Any,
     vae_params: Any,
@@ -155,11 +158,12 @@ def _text2image_jit(
     guidance_scale: jax.Array,
     uncond_per_step: Optional[jax.Array],
     return_store: bool,
+    progress: bool = False,
 ):
     context = jnp.concatenate([context_uncond, context_cond], axis=0)
     latents, state = _denoise_scan(
         unet_params, cfg, layout, schedule, scheduler_kind, context, latents,
-        controller, guidance_scale, uncond_per_step)
+        controller, guidance_scale, uncond_per_step, progress=progress)
     image = vae_mod.decode(vae_params, cfg.vae, latents.astype(jnp.float32))
     image = vae_mod.to_uint8(image)
     return (image, latents, state) if return_store else (image, latents, ())
@@ -179,6 +183,7 @@ def text2image(
     layout: Optional[AttnLayout] = None,
     dtype=jnp.float32,
     return_store: bool = False,
+    progress: bool = False,
 ):
     """Generate an edit group of images from prompts under attention control —
     the `/root/reference/ptp_utils.py:129-172` entry point.
@@ -215,8 +220,15 @@ def text2image(
     context_uncond = encode_prompts(pipe, [""] * len(prompts), dtype=dtype)
 
     x_t, latents = init_latent(latent, pipe.latent_shape, rng, len(prompts), dtype)
+    if progress:
+        # Drain any still-in-flight callbacks from a previous progress run
+        # (dispatch is async) so late steps can't poison the new reporter's
+        # monotonic step filter.
+        jax.effects_barrier()
+        total = schedule.timesteps.shape[0]
+        progress_mod.set_active(progress_mod.StepReporter(total))
     image, latents_out, state = _text2image_jit(
         pipe.unet_params, pipe.vae_params, cfg, layout, schedule, scheduler,
         context_cond, context_uncond, latents, controller, gs,
-        uncond_embeddings, return_store)
+        uncond_embeddings, return_store, progress=progress)
     return image, x_t, state

@@ -232,8 +232,15 @@ def _apply_attention(p: Params, x: jax.Array, context: jax.Array, heads: int,
             ctx.controller, meta, ctx.state, probs, ctx.step)
         out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
     elif (ctx.sp is not None and not is_cross
-          and meta.pixels >= ctx.sp.min_pixels
-          and meta.pixels % ctx.sp.mesh.shape[ctx.sp.axis] == 0):
+          and meta.pixels >= ctx.sp.min_pixels):
+        n = ctx.sp.mesh.shape[ctx.sp.axis]
+        if meta.pixels % n:
+            # Falling back silently would re-materialize the O(P²) scores on
+            # one device — the exact blow-up SpConfig exists to avoid.
+            raise ValueError(
+                f"sequence-parallel site {meta.layer_idx} has {meta.pixels} "
+                f"pixels, not divisible by mesh axis {ctx.sp.axis!r}={n}; "
+                f"choose a divisor axis size or raise SpConfig.min_pixels")
         from ..parallel.ring import ring_self_attention
 
         out = ring_self_attention(q, k, v, scale, ctx.sp.mesh, ctx.sp.axis)

@@ -1,0 +1,99 @@
+"""Progress + profiling hooks — the observability layer.
+
+The reference shows a tqdm bar over timesteps (`/root/reference/ptp_utils.py:21,167`)
+and a manually-ticked bar over null-text inner iterations
+(`/root/reference/null_text.py:578,596-600`). Inside a jitted ``lax.scan``
+there is no Python loop to hang a bar on, so progress is reported from the
+compiled program via ``jax.debug.callback``: the scan body emits its step
+index, and a host-side reporter turns the stream into a single rewriting
+line with measured ms/step. The callback is async (no device sync); when
+``progress=False`` nothing is traced in, so the silent path's XLA program is
+unchanged.
+
+``trace(logdir)`` wraps a block in a ``jax.profiler`` trace — the TPU-native
+answer to SURVEY §5's "tracing: none". The resulting directory contains an
+xplane + chrome-trace (``*.trace.json.gz``) viewable in Perfetto/TensorBoard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import time
+from typing import Optional
+
+import jax
+
+
+class StepReporter:
+    """Host-side sink for step-index callbacks from a compiled loop.
+
+    Async callbacks can arrive out of order; the reporter tracks the highest
+    step seen and smoothed step time. Writes a single rewriting line to
+    stderr (a terminal-friendly stand-in for tqdm)."""
+
+    def __init__(self, total: int, label: str = "sampling", stream=None):
+        self.total = int(total)
+        self.label = label
+        self.stream = stream or sys.stderr
+        self._last_step = -1
+        self._last_t = None
+        self._ema_ms = None
+
+    def __call__(self, step) -> None:
+        step = int(step)
+        now = time.perf_counter()
+        if step <= self._last_step:
+            return
+        if self._last_t is not None and step > 0:
+            dt_ms = (now - self._last_t) / max(1, step - self._last_step) * 1000
+            self._ema_ms = (dt_ms if self._ema_ms is None
+                            else 0.7 * self._ema_ms + 0.3 * dt_ms)
+        self._last_step = step
+        self._last_t = now
+        rate = f" {self._ema_ms:6.1f} ms/step" if self._ema_ms else ""
+        self.stream.write(f"\r{self.label}: step {step + 1}/{self.total}{rate}")
+        self.stream.flush()
+        if step + 1 >= self.total:
+            self.stream.write("\n")
+
+
+# The compiled program must not bake a particular reporter instance in (the
+# jit cache outlives any one call), so the traced callback targets this
+# module-level slot; callers install their reporter just before launching.
+_active: Optional[StepReporter] = None
+
+
+def set_active(reporter: Optional[StepReporter]) -> None:
+    global _active
+    _active = reporter
+
+
+def _dispatch(step) -> None:
+    r = _active
+    if r is not None:
+        r(step)
+
+
+def emit_step(enabled: bool, step) -> None:
+    """Trace-time: emit ``step`` to the active reporter from inside a jitted
+    loop. With ``enabled=False`` nothing is traced in — the compiled program
+    is identical to the silent one."""
+    if enabled:
+        jax.debug.callback(_dispatch, step, ordered=False)
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str]):
+    """``with trace("/tmp/p2p_trace"): ...`` — jax.profiler trace of the
+    block; no-op when ``logdir`` is falsy. NOTE (axon-tunneled TPU): stopping
+    a trace can wedge the chip lease for a while; profile at the end of a
+    session."""
+    if not logdir:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
