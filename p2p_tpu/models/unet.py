@@ -235,15 +235,20 @@ def _apply_attention(p: Params, x: jax.Array, context: jax.Array, heads: int,
           and meta.pixels >= ctx.sp.min_pixels):
         n = ctx.sp.mesh.shape[ctx.sp.axis]
         if meta.pixels % n:
-            # Falling back silently would re-materialize the O(P²) scores on
-            # one device — the exact blow-up SpConfig exists to avoid.
-            raise ValueError(
-                f"sequence-parallel site {meta.layer_idx} has {meta.pixels} "
-                f"pixels, not divisible by mesh axis {ctx.sp.axis!r}={n}; "
-                f"choose a divisor axis size or raise SpConfig.min_pixels")
-        from ..parallel.ring import ring_self_attention
+            # Fall back to local fused attention (for flash-tileable sizes
+            # that's still blockwise — no O(P²) materialization), but say so:
+            # the user asked for sharding and this site won't get it.
+            import warnings
 
-        out = ring_self_attention(q, k, v, scale, ctx.sp.mesh, ctx.sp.axis)
+            warnings.warn(
+                f"sequence-parallel site {meta.layer_idx}: {meta.pixels} "
+                f"pixels not divisible by mesh axis {ctx.sp.axis!r}={n}; "
+                f"running this site unsharded on one device", stacklevel=2)
+            out = nn.fused_attention(q, k, v, scale)
+        else:
+            from ..parallel.ring import ring_self_attention
+
+            out = ring_self_attention(q, k, v, scale, ctx.sp.mesh, ctx.sp.axis)
     else:
         out = nn.fused_attention(q, k, v, scale)
 

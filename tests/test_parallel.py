@@ -83,18 +83,27 @@ def test_param_specs_shard_attention_kernels():
 
 def test_dp_sweep_matches_sequential(tiny_pipe, devices):
     """G edit groups sharded over dp must produce the same images as running
-    each group alone (groups are independent by construction)."""
+    each group alone — for EVERY group, with a *different* controller per
+    group (the sweep's claim is that edit parameters are traced leaves, so
+    distinct equalizers/windows ride one compiled program)."""
     cfg = TINY
     tok = tiny_pipe.tokenizer
     prompts = ["a cat riding a bike", "a dog riding a bike"]
     mesh = make_mesh(4, tp=1, devices=devices[:4])
 
-    ctrl = factory.attention_replace(
-        prompts, 2, cross_replace_steps=0.8, self_replace_steps=0.4,
-        tokenizer=tok, self_max_pixels=64, max_len=cfg.text.max_length)
     g = 4
-    ctrls = jax.tree_util.tree_map(
-        lambda x: jnp.broadcast_to(x, (g,) + x.shape), ctrl)
+    # Per-group differing traced leaves: equalizer scale AND self window.
+    from p2p_tpu.align.words import get_equalizer
+
+    ctrls_list = []
+    for i, (scale, self_steps) in enumerate(
+            zip((0.25, 1.0, 2.0, 5.0), (0.0, 0.5, 0.5, 1.0))):
+        eq = get_equalizer(prompts[1], ("bike",), (scale,), tok)
+        ctrls_list.append(factory.attention_reweight(
+            prompts, 2, cross_replace_steps=0.8, self_replace_steps=self_steps,
+            equalizer=eq, tokenizer=tok, self_max_pixels=64,
+            max_len=cfg.text.max_length))
+    ctrls = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ctrls_list)
 
     ctx_c = encode_prompts(tiny_pipe, prompts)
     ctx_u = encode_prompts(tiny_pipe, [""] * 2)
@@ -105,8 +114,17 @@ def test_dp_sweep_matches_sequential(tiny_pipe, devices):
     imgs, _ = sweep(tiny_pipe, ctx_g, lats, ctrls, num_steps=2, mesh=mesh)
     assert imgs.shape == (g, 2, cfg.image_size, cfg.image_size, 3)
 
-    imgs1, _ = sweep(tiny_pipe, ctx_g[:1], lats[:1],
-                     jax.tree_util.tree_map(lambda x: x[:1], ctrls),
-                     num_steps=2, mesh=None)
-    np.testing.assert_allclose(np.asarray(imgs[0], np.float32),
-                               np.asarray(imgs1[0], np.float32), atol=1.0)
+    # Sequential oracle: every group alone, no mesh. Same math modulo XLA
+    # reassociation — allow one uint8 level.
+    for i in range(g):
+        imgs1, _ = sweep(tiny_pipe,
+                         ctx_g[i:i + 1], lats[i:i + 1],
+                         jax.tree_util.tree_map(lambda x: x[i:i + 1], ctrls),
+                         num_steps=2, mesh=None)
+        np.testing.assert_allclose(
+            np.asarray(imgs[i], np.float32), np.asarray(imgs1[0], np.float32),
+            atol=1.0, err_msg=f"group {i} diverged from sequential run")
+
+    # The controllers genuinely differ: extreme equalizer groups must not
+    # produce identical edited images.
+    assert not np.array_equal(np.asarray(imgs[0][1]), np.asarray(imgs[3][1]))

@@ -1,0 +1,53 @@
+"""Break down where the 48ms/step goes: UNet vs VAE vs text-encode; FLOPs."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+d = jax.devices()[0]
+print(f"device: {d.device_kind} platform={d.platform}", flush=True)
+
+from p2p_tpu.models import SD14, init_unet, unet_layout
+from p2p_tpu.models import vae as vae_mod
+from p2p_tpu.models.unet import apply_unet
+
+cfg = SD14
+layout = unet_layout(cfg.unet)
+params = init_unet(jax.random.PRNGKey(0), cfg.unet)
+B = 4  # CFG-doubled 2-prompt batch
+s = cfg.latent_size
+dtype = jnp.bfloat16
+
+x = jnp.ones((B, s, s, cfg.unet.in_channels), dtype)
+ctx = jnp.ones((B, cfg.unet.context_len, cfg.unet.context_dim), dtype)
+
+@jax.jit
+def unet_scan(params, x, ctx):
+    def body(h, t):
+        eps, _ = apply_unet(params, cfg.unet, h, t, ctx, layout=layout)
+        return eps, None
+    out, _ = jax.lax.scan(body, x, jnp.arange(50, dtype=jnp.int32))
+    return out
+
+# FLOPs of a single forward
+single = jax.jit(lambda p, x, c: apply_unet(p, cfg.unet, x, jnp.int32(1), c, layout=layout)[0])
+lowered = single.lower(params, x, ctx)
+compiled = lowered.compile()
+ca = compiled.cost_analysis()
+flops = ca.get("flops", 0.0) if isinstance(ca, dict) else ca[0]["flops"]
+print(f"single fwd flops (batch {B}): {flops/1e12:.3f} TF", flush=True)
+
+t0 = time.perf_counter(); r = np.asarray(unet_scan(params, x, ctx)); print(f"unet_scan compile {time.perf_counter()-t0:.1f}s", flush=True)
+for _ in range(2):
+    t0 = time.perf_counter(); r = np.asarray(unet_scan(params, x, ctx)); dt = time.perf_counter()-t0
+    print(f"unet 50-step scan: {dt*1000:.0f} ms -> {dt/50*1000:.2f} ms/step, "
+          f"{flops*50/dt/1e12:.1f} TF/s", flush=True)
+
+# VAE decode timing (f32, as the pipeline runs it)
+vparams = vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae)
+lat = jnp.ones((2, s, s, cfg.unet.in_channels), jnp.float32)
+vdec = jax.jit(lambda p, l: vae_mod.to_uint8(vae_mod.decode(p, cfg.vae, l)))
+t0 = time.perf_counter(); np.asarray(vdec(vparams, lat)); print(f"vae compile {time.perf_counter()-t0:.1f}s", flush=True)
+t0 = time.perf_counter(); np.asarray(vdec(vparams, lat)); print(f"vae decode: {(time.perf_counter()-t0)*1000:.0f} ms", flush=True)

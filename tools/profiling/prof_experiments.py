@@ -1,0 +1,89 @@
+"""Experiments: small-site attention lowering, bf16 VAE, batch scaling."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2p_tpu.models import SD14, init_unet, unet_layout
+from p2p_tpu.models import vae as vae_mod
+from p2p_tpu.models import nn as nn_mod
+from p2p_tpu.models.unet import apply_unet
+
+cfg = SD14
+layout = unet_layout(cfg.unet)
+params = init_unet(jax.random.PRNGKey(0), cfg.unet)
+s = cfg.latent_size
+
+def time_scan(B, label, steps=50):
+    x = jnp.ones((B, s, s, cfg.unet.in_channels), jnp.bfloat16)
+    ctx = jnp.ones((B, cfg.unet.context_len, cfg.unet.context_dim), jnp.bfloat16)
+    @jax.jit
+    def scan(params, x, ctx):
+        def body(h, t):
+            eps, _ = apply_unet(params, cfg.unet, h, t, ctx, layout=layout)
+            return eps, None
+        out, _ = jax.lax.scan(body, x, jnp.arange(steps, dtype=jnp.int32))
+        return out
+    t0 = time.perf_counter(); np.asarray(scan(params, x, ctx))
+    compile_s = time.perf_counter() - t0
+    best = 1e9
+    for _ in range(2):
+        t0 = time.perf_counter(); np.asarray(scan(params, x, ctx))
+        best = min(best, time.perf_counter() - t0)
+    print(f"{label:28s} B={B:2d}: {best/steps*1000:7.2f} ms/step  "
+          f"({B/2 * steps / best / steps:5.2f} img/s-equiv x50step) compile {compile_s:.0f}s",
+          flush=True)
+    return best / steps
+
+# 1. baseline fused (current: einsum f32 probs for S<2048, flash for 4096)
+t_base = time_scan(4, "baseline")
+
+# 2. dot_product_attention for ALL untouched sites
+orig_fused = nn_mod.fused_attention
+def fused_dpa(q, k, v, scale, mask=None):
+    if mask is None:
+        out = jax.nn.dot_product_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), scale=scale)
+        return out.transpose(0, 2, 1, 3)
+    return orig_fused(q, k, v, scale, mask)
+nn_mod.fused_attention = fused_dpa
+import p2p_tpu.models.unet as unet_mod
+unet_mod.nn.fused_attention = fused_dpa
+t_dpa = time_scan(4, "dot_product_attention all")
+
+# 3. flash kernel down to S>=1024 (32² sites), dpa below
+from jax.experimental.pallas.ops.tpu import flash_attention as _fa
+def fused_flash1024(q, k, v, scale, mask=None):
+    s_q, s_k = q.shape[-2], k.shape[-2]
+    if mask is None and s_q == s_k and s_q >= 1024:
+        blk = next((b for b in (1024, 512, 256) if s_q % b == 0), 0)
+        if blk:
+            sizes = _fa.BlockSizes(block_q=blk, block_k_major=blk, block_k=blk,
+                block_b=1, block_q_major_dkv=blk, block_k_major_dkv=blk,
+                block_q_dkv=blk, block_k_dkv=blk)
+            return _fa.flash_attention(q, k, v, causal=False, sm_scale=scale,
+                                       block_sizes=sizes)
+    return fused_dpa(q, k, v, scale, mask)
+nn_mod.fused_attention = fused_flash1024
+unet_mod.nn.fused_attention = fused_flash1024
+t_flash = time_scan(4, "flash>=1024 + dpa")
+
+# restore
+nn_mod.fused_attention = orig_fused
+unet_mod.nn.fused_attention = orig_fused
+
+# 4. batch scaling with the best variant so far (baseline for now)
+for B in (8, 16):
+    time_scan(B, "baseline batchscale", steps=25)
+
+# 5. VAE decode bf16 vs f32
+vparams = vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae)
+for dt, name in ((jnp.float32, "vae f32"), (jnp.bfloat16, "vae bf16")):
+    lat = jnp.ones((2, s, s, cfg.unet.in_channels), dt)
+    vdec = jax.jit(lambda p, l: vae_mod.to_uint8(vae_mod.decode(p, cfg.vae, l)))
+    np.asarray(vdec(vparams, lat))
+    t0 = time.perf_counter(); np.asarray(vdec(vparams, lat))
+    print(f"{name}: {(time.perf_counter()-t0)*1000:.0f} ms", flush=True)

@@ -1,0 +1,56 @@
+"""Measure: bf16-arithmetic GroupNorm effect + flash block-size sweep."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+from p2p_tpu.models import SD14, init_unet, unet_layout
+from p2p_tpu.models import nn as nn_mod
+from p2p_tpu.models.unet import apply_unet
+import p2p_tpu.models.unet as unet_mod
+from jax.experimental.pallas.ops.tpu import flash_attention as _fa
+
+cfg = SD14
+layout = unet_layout(cfg.unet)
+params = init_unet(jax.random.PRNGKey(0), cfg.unet)
+s = cfg.latent_size
+B = 4
+x = jnp.ones((B, s, s, cfg.unet.in_channels), jnp.bfloat16)
+ctx = jnp.ones((B, cfg.unet.context_len, cfg.unet.context_dim), jnp.bfloat16)
+
+def bench(label):
+    @jax.jit
+    def scan(params, x, ctx):
+        def body(h, t):
+            eps, _ = apply_unet(params, cfg.unet, h, t, ctx, layout=layout)
+            return eps, None
+        out, _ = jax.lax.scan(body, x, jnp.arange(50, dtype=jnp.int32))
+        return out
+    t0 = time.perf_counter(); np.asarray(scan(params, x, ctx)); c = time.perf_counter()-t0
+    best = 1e9
+    for _ in range(2):
+        t0 = time.perf_counter(); np.asarray(scan(params, x, ctx))
+        best = min(best, time.perf_counter()-t0)
+    print(f"{label:40s}: {best/50*1000:6.2f} ms/step (compile {c:.0f}s)", flush=True)
+
+bench("new GN, flash blk1024 (>=2048)")
+
+orig = nn_mod.fused_attention
+def make_fused(minseq, bq, bk):
+    def fused(q, k, v, scale, mask=None):
+        s_q, s_k = q.shape[-2], k.shape[-2]
+        if mask is None and s_q == s_k and s_q >= minseq and s_q % bq == 0 and s_q % bk == 0:
+            sizes = _fa.BlockSizes(block_q=bq, block_k_major=bk, block_k=bk,
+                block_b=1, block_q_major_dkv=bq, block_k_major_dkv=bk,
+                block_q_dkv=bq, block_k_dkv=bk)
+            return _fa.flash_attention(q, k, v, causal=False, sm_scale=scale,
+                                       block_sizes=sizes)
+        return orig(q, k, v, scale, mask)
+    return fused
+
+for (minseq, bq, bk) in [(2048, 2048, 1024), (2048, 512, 1024), (2048, 1024, 512),
+                         (2048, 512, 512), (1024, 1024, 1024), (1024, 512, 512)]:
+    f = make_fused(minseq, bq, bk)
+    nn_mod.fused_attention = f
+    unet_mod.nn.fused_attention = f
+    bench(f"flash minseq={minseq} bq={bq} bk={bk}")
+nn_mod.fused_attention = orig
+unet_mod.nn.fused_attention = orig

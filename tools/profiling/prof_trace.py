@@ -1,0 +1,37 @@
+"""Capture a jax.profiler trace of the UNet scan and dump HLO op stats."""
+import sys, time, glob, os
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+from p2p_tpu.models import SD14, init_unet, unet_layout
+from p2p_tpu.models.unet import apply_unet
+
+cfg = SD14
+layout = unet_layout(cfg.unet)
+params = init_unet(jax.random.PRNGKey(0), cfg.unet)
+s = cfg.latent_size
+B = 4
+x = jnp.ones((B, s, s, cfg.unet.in_channels), jnp.bfloat16)
+ctx = jnp.ones((B, cfg.unet.context_len, cfg.unet.context_dim), jnp.bfloat16)
+
+@jax.jit
+def scan(params, x, ctx):
+    def body(h, t):
+        eps, _ = apply_unet(params, cfg.unet, h, t, ctx, layout=layout)
+        return eps, None
+    out, _ = jax.lax.scan(body, x, jnp.arange(50, dtype=jnp.int32))
+    return out
+
+np.asarray(scan(params, x, ctx))  # compile
+logdir = "/root/repo/scratch/trace"
+os.system(f"rm -rf {logdir}")
+jax.profiler.start_trace(logdir)
+np.asarray(scan(params, x, ctx))
+jax.profiler.stop_trace()
+
+xplanes = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+print("xplane:", xplanes, flush=True)
+from tensorboard_plugin_profile.convert import raw_to_tool_data
+data, _ = raw_to_tool_data.xspace_to_tool_data(xplanes, "framework_op_stats", {})
+open("/root/repo/scratch/op_stats.out", "wb").write(
+    data if isinstance(data, bytes) else data.encode())
+print("wrote op_stats.out", flush=True)
