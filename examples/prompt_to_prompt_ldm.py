@@ -1,0 +1,80 @@
+"""Prompt-to-Prompt on the LDM text2im-256 backend — script equivalent of the
+reference's `prompt-to-prompt_ldm.ipynb` tutorial (blob absent from the
+reference checkout; behavior spec `/root/reference/ptp_utils.py:98-126`):
+BERT-tokenized prompts, LDMBert-style encoder, guidance 5, VQ decode.
+
+    python examples/prompt_to_prompt_ldm.py --preset tiny-ldm --out-dir /tmp/ldm
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def build_pipeline(args):
+    from p2p_tpu.engine.sampler import Pipeline
+    from p2p_tpu.models import LDM256, TINY_LDM, init_text_encoder, init_unet
+    from p2p_tpu.models import vae as vae_mod
+    from p2p_tpu.utils.tokenizer import HashWordTokenizer
+
+    cfg = {"tiny-ldm": TINY_LDM, "ldm256": LDM256}[args.preset]
+    if args.checkpoint:
+        from p2p_tpu.models.checkpoint import load_pipeline
+
+        return load_pipeline(args.checkpoint, cfg)
+    tok = HashWordTokenizer(vocab_size=cfg.text.vocab_size,
+                            model_max_length=cfg.text.max_length)
+    return Pipeline(
+        config=cfg,
+        unet_params=init_unet(jax.random.PRNGKey(0), cfg.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(1), cfg.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae),
+        tokenizer=tok,
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", choices=("tiny-ldm", "ldm256"), default="tiny-ldm")
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=888)
+    ap.add_argument("--out-dir", default="outputs/p2p_ldm")
+    args = ap.parse_args()
+
+    from p2p_tpu.controllers import factory
+    from p2p_tpu.engine.sampler import text2image
+    from p2p_tpu.utils import viz
+
+    pipe = build_pipeline(args)
+    steps = args.steps or (4 if args.preset == "tiny-ldm" else 50)
+    max_len = pipe.config.text.max_length
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    # The reference LDM demo: replace a word across a prompt batch at
+    # guidance 5 (`/root/reference/ptp_utils.py:103` default).
+    prompts = ["a painting of a virus monster playing guitar",
+               "a painting of a virus monster playing piano"]
+    base, x_t, _ = text2image(pipe, prompts, None, num_steps=steps,
+                              rng=jax.random.PRNGKey(args.seed), progress=True)
+    viz.view_images(np.asarray(base),
+                    save_path=os.path.join(args.out_dir, "baseline.png"))
+
+    replace = factory.attention_replace(
+        prompts, steps, cross_replace_steps=0.8, self_replace_steps=0.4,
+        tokenizer=pipe.tokenizer, max_len=max_len)
+    imgs, _, _ = text2image(pipe, prompts, replace, num_steps=steps,
+                            latent=x_t, progress=True)
+    viz.view_images(np.asarray(imgs),
+                    save_path=os.path.join(args.out_dir, "replace.png"))
+    print(f"wrote {args.out_dir}/baseline.png, replace.png")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,131 @@
+"""Prompt-to-Prompt on Stable Diffusion — script equivalent of the
+reference's `prompt-to-prompt_stable.ipynb` tutorial (the notebook blob is
+absent from the reference checkout; `/root/reference/README.md:101-103`).
+
+Walks the full edit algebra on one shared seed: baseline, AttentionReplace,
+AttentionRefine, AttentionReweight (chained), LocalBlend, and the
+cross-attention visualization. Runs on random weights with --preset tiny
+(shapes only), or on a real checkpoint directory with --checkpoint.
+
+    python examples/prompt_to_prompt_stable.py --preset tiny --out-dir /tmp/p2p
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def build_pipeline(args):
+    from p2p_tpu.engine.sampler import Pipeline
+    from p2p_tpu.models import SD14, TINY, init_text_encoder, init_unet
+    from p2p_tpu.models import vae as vae_mod
+    from p2p_tpu.utils.tokenizer import HashWordTokenizer
+
+    cfg = {"tiny": TINY, "sd14": SD14}[args.preset]
+    if args.checkpoint:
+        from p2p_tpu.models.checkpoint import load_pipeline
+
+        return load_pipeline(args.checkpoint, cfg)
+    tok = HashWordTokenizer(model_max_length=cfg.text.max_length)
+    return Pipeline(
+        config=cfg,
+        unet_params=init_unet(jax.random.PRNGKey(0), cfg.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(1), cfg.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae),
+        tokenizer=tok,
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", choices=("tiny", "sd14"), default="tiny")
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=8888)
+    ap.add_argument("--out-dir", default="outputs/p2p_stable")
+    args = ap.parse_args()
+
+    from p2p_tpu.controllers import factory
+    from p2p_tpu.engine.sampler import text2image
+    from p2p_tpu.utils import viz
+
+    pipe = build_pipeline(args)
+    steps = args.steps or (4 if args.preset == "tiny" else 50)
+    max_len = pipe.config.text.max_length
+    os.makedirs(args.out_dir, exist_ok=True)
+    rng = jax.random.PRNGKey(args.seed)
+
+    def save(name, images):
+        viz.view_images(np.asarray(images),
+                        save_path=os.path.join(args.out_dir, name))
+        print(f"wrote {args.out_dir}/{name}")
+
+    # --- 1. Baseline: same seed, no controller --------------------------------
+    prompts = ["a painting of a squirrel eating a burger",
+               "a painting of a squirrel eating a lasagna"]
+    base_imgs, x_t, _ = text2image(pipe, prompts, None, num_steps=steps,
+                                   rng=rng, progress=True)
+    save("baseline.png", base_imgs)
+
+    # --- 2. AttentionReplace: word swap, shared structure ---------------------
+    replace = factory.attention_replace(
+        prompts, steps, cross_replace_steps=0.8, self_replace_steps=0.4,
+        tokenizer=pipe.tokenizer, max_len=max_len)
+    imgs, _, store = text2image(pipe, prompts, replace, num_steps=steps,
+                                latent=x_t, return_store=True, progress=True)
+    save("replace.png", imgs)
+
+    # Cross-attention heatmaps per token of the source prompt.
+    from p2p_tpu.models.config import unet_layout
+
+    layout = unet_layout(pipe.config.unet)
+    res = pipe.config.latent_size // 2 if args.preset == "tiny" else 16
+    viz.show_cross_attention(
+        pipe.tokenizer, prompts[0], layout, store, steps, res=res,
+        from_where=("up", "down"),
+        save_path=os.path.join(args.out_dir, "cross_attention.png"))
+    print(f"wrote {args.out_dir}/cross_attention.png")
+
+    # --- 3. AttentionRefine: add words ----------------------------------------
+    refine_prompts = ["a painting of a squirrel eating a burger",
+                      "a neoclassical painting of a squirrel eating a burger"]
+    refine = factory.attention_refine(
+        refine_prompts, steps, cross_replace_steps=0.8, self_replace_steps=0.6,
+        tokenizer=pipe.tokenizer, max_len=max_len)
+    imgs, _, _ = text2image(pipe, refine_prompts, refine, num_steps=steps,
+                            latent=x_t, progress=True)
+    save("refine.png", imgs)
+
+    # --- 4. AttentionReweight chained on Replace ------------------------------
+    from p2p_tpu.align.words import get_equalizer
+
+    eq = get_equalizer(prompts[1], ("lasagna",), (4.0,), pipe.tokenizer,
+                       mode="paired")
+    reweight = factory.attention_reweight(
+        prompts, steps, cross_replace_steps=0.8, self_replace_steps=0.4,
+        equalizer=eq, tokenizer=pipe.tokenizer, base=replace, max_len=max_len)
+    imgs, _, _ = text2image(pipe, prompts, reweight, num_steps=steps,
+                            latent=x_t, progress=True)
+    save("reweight.png", imgs)
+
+    # --- 5. LocalBlend: edit only where the word attends ----------------------
+    blend_res = pipe.config.latent_size // 4
+    lb = factory.local_blend(prompts, ["burger", "lasagna"], pipe.tokenizer,
+                             num_steps=steps, resolution=blend_res,
+                             max_len=max_len)
+    blended = factory.attention_replace(
+        prompts, steps, cross_replace_steps=0.8, self_replace_steps=0.4,
+        tokenizer=pipe.tokenizer, local_blend=lb, max_len=max_len)
+    imgs, _, _ = text2image(pipe, prompts, blended, num_steps=steps,
+                            latent=x_t, progress=True)
+    save("local_blend.png", imgs)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -235,15 +235,25 @@ def _apply_attention(p: Params, x: jax.Array, context: jax.Array, heads: int,
           and meta.pixels >= ctx.sp.min_pixels):
         n = ctx.sp.mesh.shape[ctx.sp.axis]
         if meta.pixels % n:
-            # Fall back to local fused attention (for flash-tileable sizes
-            # that's still blockwise — no O(P²) materialization), but say so:
-            # the user asked for sharding and this site won't get it.
+            # Unsharded fallback is safe only when fused attention stays
+            # blockwise (flash-tileable: S ≥ 2048 with a power-of-two block
+            # dividing it). Otherwise the einsum path would materialize the
+            # O(P²) scores on one device — the blow-up SpConfig exists to
+            # avoid — so that case is an error, not a warning.
+            flash_ok = meta.pixels >= 2048 and any(
+                meta.pixels % b == 0 for b in (1024, 512, 256))
+            if not flash_ok:
+                raise ValueError(
+                    f"sequence-parallel site {meta.layer_idx} has "
+                    f"{meta.pixels} pixels, not divisible by mesh axis "
+                    f"{ctx.sp.axis!r}={n}, and not flash-tileable locally; "
+                    f"choose a divisor axis size or raise SpConfig.min_pixels")
             import warnings
 
             warnings.warn(
                 f"sequence-parallel site {meta.layer_idx}: {meta.pixels} "
                 f"pixels not divisible by mesh axis {ctx.sp.axis!r}={n}; "
-                f"running this site unsharded on one device", stacklevel=2)
+                f"running this site unsharded (local flash)", stacklevel=2)
             out = nn.fused_attention(q, k, v, scale)
         else:
             from ..parallel.ring import ring_self_attention

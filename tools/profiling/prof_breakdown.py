@@ -1,6 +1,7 @@
 """Break down where the 48ms/step goes: UNet vs VAE vs text-encode; FLOPs."""
-import sys, time
-sys.path.insert(0, "/root/repo")
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
 
 import jax
 import jax.numpy as jnp

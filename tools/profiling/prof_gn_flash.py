@@ -1,6 +1,7 @@
 """Measure: bf16-arithmetic GroupNorm effect + flash block-size sweep."""
-import sys, time
-sys.path.insert(0, "/root/repo")
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
 import jax, jax.numpy as jnp, numpy as np
 from p2p_tpu.models import SD14, init_unet, unet_layout
 from p2p_tpu.models import nn as nn_mod

@@ -1,6 +1,7 @@
 """Capture a jax.profiler trace of the UNet scan and dump HLO op stats."""
-import sys, time, glob, os
-sys.path.insert(0, "/root/repo")
+import os, sys, time, glob, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
 import jax, jax.numpy as jnp, numpy as np
 from p2p_tpu.models import SD14, init_unet, unet_layout
 from p2p_tpu.models.unet import apply_unet
@@ -22,7 +23,7 @@ def scan(params, x, ctx):
     return out
 
 np.asarray(scan(params, x, ctx))  # compile
-logdir = "/root/repo/scratch/trace"
+logdir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "trace_out")
 os.system(f"rm -rf {logdir}")
 jax.profiler.start_trace(logdir)
 np.asarray(scan(params, x, ctx))
@@ -32,6 +33,6 @@ xplanes = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
 print("xplane:", xplanes, flush=True)
 from tensorboard_plugin_profile.convert import raw_to_tool_data
 data, _ = raw_to_tool_data.xspace_to_tool_data(xplanes, "framework_op_stats", {})
-open("/root/repo/scratch/op_stats.out", "wb").write(
+open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "op_stats.out"), "wb").write(
     data if isinstance(data, bytes) else data.encode())
 print("wrote op_stats.out", flush=True)

@@ -5,8 +5,9 @@ Variants isolate the cost components:
   edit_store   — AttentionReplace, store=True (current bench default)
   edit_nostore — AttentionReplace, store=False
 """
-import sys, time
-sys.path.insert(0, "/root/repo")
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
 
 import jax
 import jax.numpy as jnp
