@@ -220,7 +220,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     def sampling_opts(sp):
         sp.add_argument("--steps", type=int, default=50)
-        sp.add_argument("--scheduler", choices=("ddim", "plms"), default="ddim")
+        sp.add_argument("--scheduler", choices=("ddim", "plms", "dpm"), default="ddim")
         sp.add_argument("--seeds", type=_int_list, default=[8191],
                         help="comma-separated seed sweep")
 

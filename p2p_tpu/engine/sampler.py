@@ -110,11 +110,18 @@ def _denoise_scan(
              if (controller is not None and controller.needs_store) else ())
 
     use_plms = scheduler_kind == "plms"
-    plms = (sched_mod.init_plms_state(latents.shape, latents.dtype) if use_plms
-            else None)
+    use_dpm = scheduler_kind == "dpm"
+    # Multistep-solver state carried through the scan (PLMS ring buffer or
+    # DPM x0 history; None for single-step DDIM).
+    if use_plms:
+        ms_state = sched_mod.init_plms_state(latents.shape, latents.dtype)
+    elif use_dpm:
+        ms_state = sched_mod.init_dpm_state(latents.shape, latents.dtype)
+    else:
+        ms_state = None
 
     def body(carry, scan_in):
-        latents, state, plms = carry
+        latents, state, ms = carry
         step, t = scan_in
         progress_mod.emit_step(progress, step)
         ctx = context
@@ -130,15 +137,17 @@ def _denoise_scan(
         eps_uncond, eps_text = eps[:b], eps[b:]
         eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
         if use_plms:
-            plms, latents = sched_mod.plms_step(schedule, plms, eps, t, latents)
+            ms, latents = sched_mod.plms_step(schedule, ms, eps, t, latents)
+        elif use_dpm:
+            ms, latents = sched_mod.dpm_step(schedule, ms, eps, t, latents)
         else:
             latents = sched_mod.ddim_step(schedule, eps, t, latents)
         latents = apply_step_callback(controller, layout, state, latents, step)
-        return (latents, state, plms), None
+        return (latents, state, ms), None
 
     steps = jnp.arange(schedule.timesteps.shape[0], dtype=jnp.int32)
     (latents, state, _), _ = jax.lax.scan(
-        body, (latents, state, plms), (steps, schedule.timesteps))
+        body, (latents, state, ms_state), (steps, schedule.timesteps))
     return latents, state
 
 

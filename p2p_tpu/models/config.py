@@ -177,7 +177,7 @@ class SchedulerConfig:
     `/root/reference/null_text.py:16-20` builds DDIM with clip_sample=False,
     set_alpha_to_one=False)."""
 
-    kind: str = "ddim"                     # default sampler: 'ddim' | 'plms'
+    kind: str = "ddim"              # default sampler: 'ddim' | 'plms' | 'dpm'
     num_train_timesteps: int = 1000
     beta_start: float = 0.00085
     beta_end: float = 0.012

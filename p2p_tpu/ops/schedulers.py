@@ -1,6 +1,7 @@
 """Diffusion schedulers as pure JAX — scan-friendly, stateless where possible.
 
-Two samplers cover the reference's paths:
+Three deterministic samplers — two covering the reference's paths, one going
+beyond it:
 
 - **DDIM** (η=0) — the null-text path's scheduler
   (`/root/reference/null_text.py:16-20`), whose closed-form ``prev_step`` /
@@ -13,8 +14,11 @@ Two samplers cover the reference's paths:
   pseudo-linear-multistep method (Liu et al., arXiv 2202.09778): an
   Adams–Bashforth combination over a ring buffer of the last 4 ε-predictions,
   carried explicitly through the scan instead of Python-side lists/counters.
+- **DPM-Solver++(2M)** (not in the reference) — a second-order multistep ODE
+  solver reaching ~50-step-DDIM quality in ~20-25 steps: the cheapest 2×
+  throughput available, since it changes only the integrator, not the model.
 
-Both share a :class:`DiffusionSchedule` of precomputed constants; per-step
+All share a :class:`DiffusionSchedule` of precomputed constants; per-step
 updates index it with the traced timestep, so one compiled program serves any
 step count with the same shapes.
 """
@@ -82,7 +86,7 @@ def make_schedule(
 ) -> DiffusionSchedule:
     """Build a :class:`DiffusionSchedule`.
 
-    ``kind='ddim'``: T timesteps ``[(T-1)·s, ..., 0] + offset``.
+    ``kind='ddim'`` / ``'dpm'``: T timesteps ``[(T-1)·s, ..., 0] + offset``.
     ``kind='plms'``: T+1 timesteps with the second one repeated — the
     warm-up double-evaluation of the first step that PLMS needs to build its
     multistep history (so a 50-step PLMS run makes 51 U-Net calls, matching
@@ -92,7 +96,7 @@ def make_schedule(
     acp = np.cumprod(1.0 - betas)
     step = num_train_timesteps // num_inference_steps
     base = (np.arange(num_inference_steps) * step).round().astype(np.int64) + steps_offset
-    if kind == "ddim":
+    if kind in ("ddim", "dpm"):
         ts = base[::-1].copy()
     elif kind == "plms":
         ts = np.concatenate([base[:-1], base[-2:-1], base[-1:]])[::-1].copy()
@@ -263,6 +267,78 @@ def plms_step(
         PlmsState(ets=new_ets, counter=c + 1, cur_sample=new_cur),
         prev_sample,
     )
+
+
+# ---------------------------------------------------------------------------
+# DPM-Solver++(2M) — beyond the reference: a second-order multistep solver
+# (Lu et al., arXiv 2211.01095) that reaches 50-step-DDIM quality in ~20-25
+# steps, i.e. ~2× throughput at matched quality. Deterministic,
+# data-prediction parameterization, scan-carried multistep state.
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class DpmState:
+    """Scan-carried DPM-Solver++ history: previous x0 prediction, its
+    log-SNR λ, and whether a previous step exists (order ramps 1→2)."""
+
+    x0_prev: jax.Array
+    lam_prev: jax.Array   # f32 scalar
+    has_prev: jax.Array   # bool scalar
+
+
+def init_dpm_state(sample_shape: Tuple[int, ...], dtype=jnp.float32) -> DpmState:
+    return DpmState(
+        x0_prev=jnp.zeros(sample_shape, dtype=dtype),
+        lam_prev=jnp.float32(0.0),
+        has_prev=jnp.asarray(False),
+    )
+
+
+def dpm_step(
+    sched: DiffusionSchedule,
+    state: DpmState,
+    eps: jax.Array,
+    t: jax.Array,
+    sample: jax.Array,
+) -> Tuple[DpmState, jax.Array]:
+    """One DPM-Solver++(2M) step x_t → x_{t-Δ}.
+
+    Data-prediction form: with α=√ā, σ=√(1−ā), λ=log(α/σ), h=λ_next−λ_t,
+        x_next = (σ_next/σ_t)·x − α_next·(e^{−h}−1)·D,
+    where D is x0 (first step / final step) or the second-order extrapolation
+    (1+1/2r)·x0 − 1/(2r)·x0_prev with r = h_prev/h. The final step (t−Δ < 0)
+    drops to first order (diffusers' ``lower_order_final``), which also keeps
+    h finite under set_alpha_to_one=True."""
+    prev_t = t - sched.step_size
+    a_t = _alpha_at(sched, t)
+    a_next = _alpha_at(sched, prev_t)
+
+    x = sample.astype(jnp.float32)
+    e = eps.astype(jnp.float32)
+    alpha_t, sigma_t = jnp.sqrt(a_t), jnp.sqrt(1.0 - a_t)
+    alpha_n, sigma_n = jnp.sqrt(a_next), jnp.sqrt(1.0 - a_next)
+    lam_t = jnp.log(alpha_t / sigma_t)
+    lam_n = jnp.log(alpha_n / sigma_n)
+    h = lam_n - lam_t
+
+    x0 = (x - sigma_t * e) / alpha_t
+    if sched.clip_sample:
+        x0 = jnp.clip(x0, -1.0, 1.0)
+
+    h_prev = lam_t - state.lam_prev
+    r = h_prev / h
+    d2 = (1.0 + 1.0 / (2.0 * r)) * x0 - (1.0 / (2.0 * r)) * state.x0_prev.astype(jnp.float32)
+    use_second = jnp.logical_and(state.has_prev, prev_t >= 0)
+    d = jnp.where(use_second, d2, x0)
+
+    x_next = (sigma_n / sigma_t) * x - alpha_n * jnp.expm1(-h) * d
+    new_state = DpmState(
+        x0_prev=x0.astype(state.x0_prev.dtype),
+        lam_prev=lam_t.astype(jnp.float32),
+        has_prev=jnp.asarray(True),
+    )
+    return new_state, x_next.astype(sample.dtype)
 
 
 # ---------------------------------------------------------------------------
