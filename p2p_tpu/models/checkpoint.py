@@ -374,12 +374,15 @@ def load_pipeline(checkpoint_dir: str, config, tokenizer=None):
                           config.vae, os.path.join(checkpoint_dir, "vae"))
     if tokenizer is None:
         tok_dir = os.path.join(checkpoint_dir, "tokenizer")
+        max_len = config.text.max_length
         if config.text.arch == "ldmbert":
             from ..utils.tokenizer import BertWordPieceTokenizer
 
-            tokenizer = BertWordPieceTokenizer.from_dir(tok_dir)
+            tokenizer = BertWordPieceTokenizer.from_dir(
+                tok_dir, model_max_length=max_len)
         else:
-            tokenizer = ClipBpeTokenizer.from_dir(tok_dir)
+            tokenizer = ClipBpeTokenizer.from_dir(
+                tok_dir, model_max_length=max_len)
     return Pipeline(config=config, unet_params=unet_params,
                     text_params=text_params, vae_params=vae_params,
                     tokenizer=tokenizer)

@@ -308,8 +308,11 @@ def dpm_step(
         x_next = (σ_next/σ_t)·x − α_next·(e^{−h}−1)·D,
     where D is x0 (first step / final step) or the second-order extrapolation
     (1+1/2r)·x0 − 1/(2r)·x0_prev with r = h_prev/h. The final step (t−Δ < 0)
-    drops to first order (diffusers' ``lower_order_final``), which also keeps
-    h finite under set_alpha_to_one=True."""
+    drops to first order (diffusers' ``lower_order_final``). Note: under
+    set_alpha_to_one=True the final step has σ_next=0 ⇒ h=+inf; the update is
+    still exact (expm1(-inf)=-1, σ-ratio term 0 ⇒ x_next = x0) but relies on
+    IEEE inf semantics — don't replace expm1 with a series expansion or add
+    h-magnitude guards without covering that case."""
     prev_t = t - sched.step_size
     a_t = _alpha_at(sched, t)
     a_next = _alpha_at(sched, prev_t)
