@@ -1,7 +1,9 @@
 """Multi-device execution: meshes, sharding rules, and sharded sweeps."""
 
 from .mesh import data_sharding, make_mesh, param_specs, shard_params
+from .multihost import global_mesh, initialize, process_groups
 from .sweep import seed_latents, sweep
 
-__all__ = ["data_sharding", "make_mesh", "param_specs", "shard_params",
-           "seed_latents", "sweep"]
+__all__ = ["data_sharding", "global_mesh", "initialize", "make_mesh",
+           "param_specs", "process_groups", "shard_params", "seed_latents",
+           "sweep"]

@@ -10,14 +10,14 @@ from p2p_tpu.cli import main
 
 def test_generate_writes_image(tmp_path):
     out = os.path.join(tmp_path, "img.png")
-    assert main(["generate", "--prompt", "a cat", "--steps", "2",
+    assert main(["generate", "--quiet", "--prompt", "a cat", "--steps", "2",
                  "--out", out]) == 0
     assert os.path.exists(out)
 
 
 def test_generate_seed_sweep_suffixes(tmp_path):
     out = os.path.join(tmp_path, "img.png")
-    assert main(["generate", "--prompt", "a cat", "--steps", "2",
+    assert main(["generate", "--quiet", "--prompt", "a cat", "--steps", "2",
                  "--seeds", "1,2", "--out", out]) == 0
     assert os.path.exists(os.path.join(tmp_path, "img_00001.png"))
     assert os.path.exists(os.path.join(tmp_path, "img_00002.png"))
@@ -25,7 +25,7 @@ def test_generate_seed_sweep_suffixes(tmp_path):
 
 def test_edit_writes_pairs(tmp_path):
     out_dir = os.path.join(tmp_path, "run")
-    assert main(["edit", "--source", "a cat riding a bike",
+    assert main(["edit", "--quiet", "--source", "a cat riding a bike",
                  "--target", "a dog riding a bike", "--mode", "replace",
                  "--steps", "2", "--seeds", "7", "--out-dir", out_dir]) == 0
     assert os.path.exists(os.path.join(out_dir, "00007_y.jpg"))
@@ -39,11 +39,11 @@ def test_invert_then_replay(tmp_path):
     rng = np.random.default_rng(0)
     Image.fromarray(rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)).save(img_path)
     art = os.path.join(tmp_path, "art.npz")
-    assert main(["invert", "--image", img_path, "--prompt", "a cat",
+    assert main(["invert", "--quiet", "--image", img_path, "--prompt", "a cat",
                  "--steps", "2", "--inner-steps", "2", "--artifact", art]) == 0
     assert os.path.exists(art)
     out_dir = os.path.join(tmp_path, "replay")
-    assert main(["replay", "--artifact", art, "--target", "a dog",
+    assert main(["replay", "--quiet", "--artifact", art, "--target", "a dog",
                  "--mode", "replace", "--out-dir", out_dir]) == 0
     assert os.path.exists(os.path.join(out_dir, "reconstruction.png"))
     assert os.path.exists(os.path.join(out_dir, "edited.png"))
@@ -51,4 +51,4 @@ def test_invert_then_replay(tmp_path):
 
 def test_rejected_unknown_flag():
     with pytest.raises(SystemExit):
-        main(["replay", "--artifact", "x.npz", "--scheduler", "plms"])
+        main(["replay", "--quiet", "--artifact", "x.npz", "--scheduler", "plms"])

@@ -128,3 +128,50 @@ def test_dp_sweep_matches_sequential(tiny_pipe, devices):
     # The controllers genuinely differ: extreme equalizer groups must not
     # produce identical edited images.
     assert not np.array_equal(np.asarray(imgs[0][1]), np.asarray(imgs[3][1]))
+
+
+def test_multihost_helpers_single_process(devices):
+    """Single-process degradation: initialize() is a no-op, global_mesh
+    covers the local devices, process_groups spans everything."""
+    from p2p_tpu.parallel import multihost
+
+    assert multihost.initialize() is False  # no coordinator configured
+    mesh = multihost.global_mesh(tp=2)
+    assert mesh.shape["tp"] == 2
+    assert mesh.shape["dp"] * 2 == len(jax.devices())
+    assert list(multihost.process_groups(5)) == [0, 1, 2, 3, 4]
+    with pytest.raises(ValueError):
+        multihost.global_mesh(tp=3)
+
+
+def test_dp_sweep_with_local_blend(tiny_pipe, devices):
+    """LocalBlend (store-consuming, latent-compositing) under the vmapped dp
+    sweep must match the sequential run — the store state rides the vmap."""
+    from p2p_tpu.controllers.factory import attention_replace, local_blend
+
+    cfg = TINY
+    tok = tiny_pipe.tokenizer
+    prompts = ["a cat riding a bike", "a dog riding a bike"]
+    mesh = make_mesh(2, tp=1, devices=devices[:2])
+    g = 2
+    lb = local_blend(prompts, ["cat", "dog"], tok, num_steps=2, resolution=8,
+                     max_len=cfg.text.max_length)
+    ctrl = attention_replace(
+        prompts, 2, cross_replace_steps=0.8, self_replace_steps=0.4,
+        tokenizer=tok, local_blend=lb, self_max_pixels=64,
+        max_len=cfg.text.max_length)
+    ctrls = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (g,) + x.shape), ctrl)
+
+    ctx_c = encode_prompts(tiny_pipe, prompts)
+    ctx_u = encode_prompts(tiny_pipe, [""] * 2)
+    ctx = jnp.concatenate([ctx_u, ctx_c], axis=0)
+    ctx_g = jnp.broadcast_to(ctx[None], (g,) + ctx.shape)
+    lats = seed_latents(jax.random.PRNGKey(9), g, 2, tiny_pipe.latent_shape)
+
+    imgs, _ = sweep(tiny_pipe, ctx_g, lats, ctrls, num_steps=2, mesh=mesh)
+    imgs0, _ = sweep(tiny_pipe, ctx_g[:1], lats[:1],
+                     jax.tree_util.tree_map(lambda x: x[:1], ctrls),
+                     num_steps=2, mesh=None)
+    np.testing.assert_allclose(np.asarray(imgs[0], np.float32),
+                               np.asarray(imgs0[0], np.float32), atol=1.0)
