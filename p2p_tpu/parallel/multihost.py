@@ -42,39 +42,47 @@ def initialize(
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
     # Keep None when unset: jax.distributed.initialize auto-detects
-    # num_processes/process_id from cluster envs (SLURM, TPU metadata, ...)
-    # only when they arrive as None.
+    # num_processes/process_id from cluster envs (SLURM, OpenMPI, TPU
+    # metadata, ...) only when they arrive as None.
     if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
     if process_id is None and os.environ.get("JAX_PROCESS_ID"):
         process_id = int(os.environ["JAX_PROCESS_ID"])
 
+    def _int_env(name):
+        try:
+            return int(os.environ.get(name, "1") or "1")
+        except ValueError:
+            return 1
+
     hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
-    if coordinator_address is None and len(hosts) <= 1:
-        return False  # single host (or single-worker TPU env): nothing to join
+    explicit = (coordinator_address is not None or num_processes is not None
+                or process_id is not None)
+    cluster = (len(hosts) > 1
+               or _int_env("SLURM_JOB_NUM_NODES") > 1
+               or _int_env("OMPI_COMM_WORLD_SIZE") > 1)
+    if not explicit and not cluster:
+        return False  # nothing indicates a multi-process launch
+
+    # initialize() must precede first backend use. Degrading per-process here
+    # would split the job topology (peers block on a coordinator that never
+    # starts, process_groups overlap) — fail loudly and identically instead.
     try:
         from jax._src import xla_bridge as _xb
 
         backends_up = _xb.backends_are_initialized()
-    except Exception:  # private API moved — just attempt the initialize
-        backends_up = False
+    except Exception:  # private API moved; jax will raise its own clear
+        backends_up = False  # RuntimeError below if we really are late
     if backends_up:
-        # initialize() must precede first backend use; a late call should
-        # degrade to local mode rather than crash the whole run.
-        import warnings
+        raise RuntimeError(
+            "multihost.initialize() must run before any JAX computation "
+            "(jax.devices(), device_put, ...) — call it first in main()")
 
-        warnings.warn("multihost.initialize() called after JAX backend init; "
-                      "staying single-process", stacklevel=2)
-        return False
-    if coordinator_address is None:
-        # TPU pod: the runtime discovers coordination from the TPU metadata.
-        jax.distributed.initialize()
-    else:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
     return jax.process_count() > 1
 
 

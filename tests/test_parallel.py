@@ -147,16 +147,14 @@ def test_multihost_helpers_single_process(devices):
 def test_dp_sweep_with_local_blend(tiny_pipe, devices):
     """LocalBlend (store-consuming, latent-compositing) under the vmapped dp
     sweep must match the sequential run — the store state rides the vmap."""
-    from p2p_tpu.controllers.factory import attention_replace, local_blend
-
     cfg = TINY
     tok = tiny_pipe.tokenizer
     prompts = ["a cat riding a bike", "a dog riding a bike"]
     mesh = make_mesh(2, tp=1, devices=devices[:2])
     g = 2
-    lb = local_blend(prompts, ["cat", "dog"], tok, num_steps=2, resolution=8,
-                     max_len=cfg.text.max_length)
-    ctrl = attention_replace(
+    lb = factory.local_blend(prompts, ["cat", "dog"], tok, num_steps=2,
+                             resolution=8, max_len=cfg.text.max_length)
+    ctrl = factory.attention_replace(
         prompts, 2, cross_replace_steps=0.8, self_replace_steps=0.4,
         tokenizer=tok, local_blend=lb, self_max_pixels=64,
         max_len=cfg.text.max_length)
