@@ -107,6 +107,7 @@ def _ddim_invert_jit(unet_params, vae_params, cfg: PipelineConfig,
         i, t = scan_in
         progress_mod.emit_step(progress, i)
         eps, _ = apply_unet(unet_params, cfg.unet, latent, t, cond)
+        eps = sched_mod.to_epsilon(schedule, eps, t, latent)
         nxt = sched_mod.ddim_next_step(schedule, eps, t, latent)
         return nxt, nxt
 
@@ -159,6 +160,7 @@ def _null_optimize_jit(unet_params, cfg: PipelineConfig,
         def loss_fn(u):
             eps_u, _ = apply_unet(unet_params, cfg.unet, latent_cur, t, u)
             eps = eps_u + guidance_scale * (eps_cond - eps_u)
+            eps = sched_mod.to_epsilon(schedule, eps, t, latent_cur)
             prev = sched_mod.ddim_step(schedule, eps, t, latent_cur)
             return jnp.mean((prev - target) ** 2)
 
@@ -183,6 +185,7 @@ def _null_optimize_jit(unet_params, cfg: PipelineConfig,
         # (`/root/reference/null_text.py:602-604`).
         eps_u, _ = apply_unet(unet_params, cfg.unet, latent_cur, t, u_opt)
         eps = eps_u + guidance_scale * (eps_cond - eps_u)
+        eps = sched_mod.to_epsilon(schedule, eps, t, latent_cur)
         latent_next = sched_mod.ddim_step(schedule, eps, t, latent_cur)
         return (latent_next, u_opt), u_opt
 

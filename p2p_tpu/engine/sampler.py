@@ -136,6 +136,9 @@ def _denoise_scan(
             layout=layout, controller=controller, state=state, step=step)
         eps_uncond, eps_text = eps[:b], eps[b:]
         eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
+        # v-prediction models (SD-2.1 768-v): convert to ε once per step.
+        # Linear in the model output, so combining CFG first is equivalent.
+        eps = sched_mod.to_epsilon(schedule, eps, t, latents)
         if use_plms:
             ms, latents = sched_mod.plms_step(schedule, ms, eps, t, latents)
         elif use_dpm:

@@ -11,6 +11,8 @@ from .config import (
     LDM256,
     TINY_LDM,
     SD14_HR,
+    SD21,
+    SD21_BASE,
     SD14,
     TINY,
     PipelineConfig,
@@ -25,7 +27,7 @@ from .unet import apply_unet, init_unet
 from . import vae
 
 __all__ = [
-    "LDM256", "SD14", "SD14_HR", "TINY", "TINY_LDM",
+    "LDM256", "SD14", "SD14_HR", "SD21", "SD21_BASE", "TINY", "TINY_LDM",
     "PipelineConfig", "TextEncoderConfig", "UNetConfig", "VAEConfig",
     "unet_attn_specs", "unet_layout",
     "apply_text_encoder", "init_text_encoder",

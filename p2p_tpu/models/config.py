@@ -188,6 +188,8 @@ class SchedulerConfig:
     # timestep shifted up by one); the null-text DDIM construction leaves it 0.
     plms_steps_offset: int = 1
     ddim_steps_offset: int = 0
+    # 'epsilon' (SD-1.x / SD-2.1-base) or 'v_prediction' (SD-2.1 768-v).
+    prediction_type: str = "epsilon"
 
     def steps_offset(self, kind: str) -> int:
         return self.plms_steps_offset if kind == "plms" else self.ddim_steps_offset
@@ -248,6 +250,22 @@ LDM256 = PipelineConfig("ldm-text2im-256", LDM_UNET, LDM_TEXT, LDM_VAE,
                         scheduler=SchedulerConfig(
                             beta_start=0.0015, beta_end=0.0195,
                             plms_steps_offset=0))
+
+# SD-2.1 family — the model the reference marks "Not work"
+# (`/root/reference/main.py:27`); here a config, not a code change: OpenCLIP
+# ViT-H text tower realized as 23 transformer layers (diffusers' checkpoint
+# conversion truncates layer 24 so the final-LN output IS the penultimate
+# hidden state SD-2 conditions on), gelu activation, 1024-wide context;
+# U-Net at fixed head_dim 64. The 768-v variant predicts v, not ε.
+SD21_TEXT = TextEncoderConfig(hidden_dim=1024, num_layers=23, num_heads=16,
+                              activation="gelu")
+SD21_UNET = UNetConfig(context_dim=1024, head_dim=64)
+SD21_BASE = PipelineConfig("sd-v2.1-base", SD21_UNET, SD21_TEXT, SD14_VAE,
+                           image_size=512)
+SD21 = PipelineConfig(
+    "sd-v2.1", dataclasses.replace(SD21_UNET, sample_size=96), SD21_TEXT,
+    SD14_VAE, image_size=768,
+    scheduler=SchedulerConfig(prediction_type="v_prediction"))
 
 # High-resolution SD variant: same weights shapes, 128² latent (1024²
 # image). The 128²-pixel self-attention sites (16384² score matrix, ~2GB

@@ -66,6 +66,9 @@ class DiffusionSchedule:
     # False for both reference-relevant configs (SD DDIM sets it explicitly,
     # `/root/reference/null_text.py:19`); static so it costs nothing when off.
     clip_sample: bool = struct.field(pytree_node=False, default=False)
+    # What the model predicts: 'epsilon' (SD-1.x, the reference's only mode)
+    # or 'v_prediction' (SD-2.1 768-v). Static; converted to ε once per step.
+    prediction_type: str = struct.field(pytree_node=False, default="epsilon")
 
     @property
     def step_size(self) -> int:
@@ -82,6 +85,7 @@ def make_schedule(
     steps_offset: int = 0,
     kind: str = "ddim",
     clip_sample: bool = False,
+    prediction_type: str = "epsilon",
     dtype=jnp.float32,
 ) -> DiffusionSchedule:
     """Build a :class:`DiffusionSchedule`.
@@ -110,6 +114,7 @@ def make_schedule(
         num_train_timesteps=num_train_timesteps,
         num_inference_steps=num_inference_steps,
         clip_sample=clip_sample,
+        prediction_type=prediction_type,
     )
 
 
@@ -129,8 +134,24 @@ def schedule_from_config(num_inference_steps: int, sched_cfg, kind: Optional[str
         steps_offset=sched_cfg.steps_offset(kind),
         kind=kind,
         clip_sample=sched_cfg.clip_sample,
+        prediction_type=sched_cfg.prediction_type,
         dtype=dtype,
     )
+
+
+def to_epsilon(sched: DiffusionSchedule, model_out: jax.Array, t: jax.Array,
+               sample: jax.Array) -> jax.Array:
+    """Convert the model output to an ε-prediction under the schedule's
+    ``prediction_type``. v-parameterization (Salimans & Ho, arXiv 2202.00512):
+    v = α·ε − σ·x₀  ⇒  ε = α·v + σ·x_t (with α=√ā, σ=√(1−ā))."""
+    if sched.prediction_type == "epsilon":
+        return model_out
+    if sched.prediction_type == "v_prediction":
+        a_t = _alpha_at(sched, t)
+        alpha, sigma = jnp.sqrt(a_t), jnp.sqrt(1.0 - a_t)
+        return (alpha * model_out.astype(jnp.float32)
+                + sigma * sample.astype(jnp.float32)).astype(model_out.dtype)
+    raise ValueError(f"unknown prediction_type: {sched.prediction_type!r}")
 
 
 def _alpha_at(sched: DiffusionSchedule, t: jax.Array) -> jax.Array:

@@ -56,8 +56,12 @@ def initialize(
             return 1
 
     hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
-    explicit = (coordinator_address is not None or num_processes is not None
-                or process_id is not None)
+    if num_processes == 1:
+        return False  # explicitly single-process: nothing to join
+    # A coordinator address (or >1 processes) is an explicit multi-process
+    # signal; bare process_id/num_processes without one cannot reach jax's
+    # initialize (it requires a coordinator), so they don't count alone.
+    explicit = coordinator_address is not None or (num_processes or 0) > 1
     cluster = (len(hosts) > 1
                or _int_env("SLURM_JOB_NUM_NODES") > 1
                or _int_env("OMPI_COMM_WORLD_SIZE") > 1)

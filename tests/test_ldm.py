@@ -139,8 +139,9 @@ def test_ldm256_schedule_is_ldm_beta_range():
 def test_all_presets_latent_image_sizes_consistent():
     """Every backend's VAE downsample count must connect latent_size to
     image_size (the LDM256 f4-vs-f8 class of bug)."""
-    from p2p_tpu.models import LDM256, SD14, SD14_HR, TINY, TINY_LDM
+    from p2p_tpu.models import (LDM256, SD14, SD14_HR, SD21, SD21_BASE,
+                                TINY, TINY_LDM)
 
-    for cfg in (SD14, SD14_HR, TINY, TINY_LDM, LDM256):
+    for cfg in (SD14, SD14_HR, SD21, SD21_BASE, TINY, TINY_LDM, LDM256):
         f = 2 ** (len(cfg.vae.channel_mults) - 1)
         assert cfg.latent_size * f == cfg.image_size, (cfg.name, f)

@@ -1,0 +1,97 @@
+"""SD-2.1 family support — the model the reference marks "Not work"
+(`/root/reference/main.py:27`): v-prediction sampling, head_dim-64 U-Net,
+OpenCLIP-style (23-layer gelu) text tower via config."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_tpu.models import SD21, SD21_BASE, TINY
+from p2p_tpu.models.config import SchedulerConfig, unet_attn_specs
+from p2p_tpu.ops.schedulers import (
+    add_noise,
+    ddim_step,
+    make_schedule,
+    to_epsilon,
+)
+
+
+def test_sd21_configs_are_consistent():
+    assert SD21_BASE.scheduler.prediction_type == "epsilon"
+    assert SD21.scheduler.prediction_type == "v_prediction"
+    assert SD21.latent_size * 8 == SD21.image_size == 768
+    assert SD21_BASE.text.num_layers == 23          # penultimate-layer trick
+    assert SD21_BASE.text.activation == "gelu"      # OpenCLIP, not quick_gelu
+    heads = {h for (_, _, _, h, _) in unet_attn_specs(SD21_BASE.unet)}
+    assert heads == {5, 10, 20}                     # head_dim 64
+
+
+def test_to_epsilon_identity_for_epsilon_models():
+    s = make_schedule(10)
+    x = jnp.ones((1, 2, 2, 1))
+    out = jnp.full_like(x, 0.3)
+    np.testing.assert_array_equal(np.asarray(to_epsilon(s, out, jnp.int32(500), x)),
+                                  np.asarray(out))
+
+
+def test_v_prediction_roundtrip_recovers_epsilon():
+    """v = α·ε − σ·x₀ and x_t = α·x₀ + σ·ε ⇒ to_epsilon(v, x_t) == ε."""
+    s = make_schedule(10, prediction_type="v_prediction")
+    rng = np.random.RandomState(0)
+    x0 = jnp.asarray(rng.randn(1, 4, 4, 2).astype(np.float32))
+    eps = jnp.asarray(rng.randn(1, 4, 4, 2).astype(np.float32))
+    for t in (980, 500, 20):
+        a = s.alphas_cumprod[t]
+        alpha, sigma = jnp.sqrt(a), jnp.sqrt(1.0 - a)
+        x_t = add_noise(s, x0, eps, jnp.int32(t))
+        v = alpha * eps - sigma * x0
+        got = to_epsilon(s, v, jnp.int32(t), x_t)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(eps),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_v_prediction_ddim_chain_recovers_x0():
+    """A model emitting the exact v lands where the ε-model chain lands."""
+    s = make_schedule(25, prediction_type="v_prediction")
+    rng = np.random.RandomState(1)
+    x0 = jnp.asarray(rng.randn(1, 4, 4, 1).astype(np.float32))
+    noise = jnp.asarray(rng.randn(1, 4, 4, 1).astype(np.float32))
+    x = add_noise(s, x0, noise, jnp.int32(980))
+
+    def v_of(x, t):
+        a = s.alphas_cumprod[t]
+        alpha, sigma = jnp.sqrt(a), jnp.sqrt(1.0 - a)
+        e = (x - alpha * x0) / sigma
+        return alpha * e - sigma * x0
+
+    for t in np.asarray(s.timesteps):
+        eps = to_epsilon(s, v_of(x, int(t)), jnp.int32(int(t)), x)
+        x = ddim_step(s, eps, jnp.int32(int(t)), x)
+    a0 = np.asarray(s.alphas_cumprod[0])
+    want = np.sqrt(a0) * np.asarray(x0) + np.sqrt(1 - a0) * np.asarray(noise)
+    np.testing.assert_allclose(np.asarray(x), want, rtol=1e-2, atol=1e-3)
+
+
+def test_v_prediction_e2e_tiny(tiny_pipe):
+    """A v-prediction backend samples end-to-end (random weights: only the
+    program structure differs from ε — conversion happens inside the scan)."""
+    from p2p_tpu.engine.sampler import Pipeline, text2image
+
+    cfg = dataclasses.replace(
+        TINY, scheduler=SchedulerConfig(prediction_type="v_prediction"))
+    pipe = Pipeline(config=cfg, unet_params=tiny_pipe.unet_params,
+                    text_params=tiny_pipe.text_params,
+                    vae_params=tiny_pipe.vae_params,
+                    tokenizer=tiny_pipe.tokenizer)
+    img, _, _ = text2image(pipe, ["a cat", "a dog"], None, num_steps=2,
+                           rng=jax.random.PRNGKey(0))
+    assert img.shape[0] == 2
+    assert np.isfinite(np.asarray(img, np.float32)).all()
+    # and it differs from the ε interpretation of the same weights
+    img_eps, _, _ = text2image(tiny_pipe, ["a cat", "a dog"], None,
+                               num_steps=2, rng=jax.random.PRNGKey(0))
+    assert not np.array_equal(np.asarray(img), np.asarray(img_eps))
