@@ -108,6 +108,7 @@ def cmd_generate(args) -> int:
                                    guidance_scale=args.guidance,
                                    scheduler=args.scheduler,
                                    rng=jax.random.PRNGKey(seed),
+                                   negative_prompt=args.negative_prompt,
                                    progress=not args.quiet)
             out = args.out
             if len(args.seeds) > 1:
@@ -135,11 +136,13 @@ def cmd_edit(args) -> int:
                                       num_steps=args.steps,
                                       guidance_scale=args.guidance,
                                       scheduler=args.scheduler, rng=rng,
+                                      negative_prompt=args.negative_prompt,
                                       progress=not args.quiet)
             img, _, _ = text2image(pipe, prompts, controller,
                                    num_steps=args.steps,
                                    guidance_scale=args.guidance,
                                    scheduler=args.scheduler, latent=x_t,
+                                   negative_prompt=args.negative_prompt,
                                    progress=not args.quiet)
             # y / y_hat naming per `/root/reference/main.py:375-380,435-444`.
             _save(np.asarray(base[0]),
@@ -235,15 +238,22 @@ def build_parser() -> argparse.ArgumentParser:
                         help="word=scale[,word=scale...] reweighting")
         sp.add_argument("--blend-resolution", type=int, default=16)
 
+    def negative_opt(sp):
+        # generate/edit only — replay's uncond comes from the inversion
+        # artifact, invert's from the null-text objective (honored-flags-only
+        # discipline: no accepted-but-ignored options).
+        sp.add_argument("--negative-prompt", default=None,
+                        help='steer CFG away from this text instead of ""')
+
     g = sub.add_parser("generate", help="text-to-image, no editing")
-    model_opts(g); sampling_opts(g)
+    model_opts(g); sampling_opts(g); negative_opt(g)
     g.add_argument("--prompt", required=True)
     g.add_argument("--out", default="outputs/image.png",
                    help="output path; seed index suffixed when sweeping")
     g.set_defaults(fn=cmd_generate)
 
     e = sub.add_parser("edit", help="prompt-to-prompt edit with seed sweep")
-    model_opts(e); sampling_opts(e); edit_opts(e)
+    model_opts(e); sampling_opts(e); edit_opts(e); negative_opt(e)
     e.add_argument("--source", required=True, help="source prompt")
     e.add_argument("--target", required=True, help="edited prompt")
     e.add_argument("--out-dir", default=None)

@@ -192,6 +192,7 @@ def text2image(
     latent: Optional[jax.Array] = None,
     rng: Optional[jax.Array] = None,
     uncond_embeddings: Optional[jax.Array] = None,
+    negative_prompt: Optional[str] = None,
     layout: Optional[AttnLayout] = None,
     dtype=jnp.float32,
     return_store: bool = False,
@@ -201,9 +202,15 @@ def text2image(
     the `/root/reference/ptp_utils.py:129-172` entry point.
 
     ``uncond_embeddings``: optional (T, 1, L, D) per-step null-text
-    embeddings; otherwise the encoded ``""`` is broadcast over all steps.
-    Returns ``(images uint8 (B,H,W,3), x_T, store_state)``.
+    embeddings; otherwise the encoded unconditional prompt is broadcast over
+    all steps. ``negative_prompt`` replaces the default ``""`` unconditional
+    text (classifier-free guidance then steers *away* from it — a diffusers
+    capability the reference lacks); mutually exclusive with
+    ``uncond_embeddings``. Returns ``(images uint8 (B,H,W,3), x_T, store)``.
     """
+    if negative_prompt and uncond_embeddings is not None:
+        raise ValueError("negative_prompt and uncond_embeddings are mutually "
+                         "exclusive (null-text already optimized the uncond)")
     cfg = pipe.config
     num_steps = num_steps or cfg.num_steps
     scheduler = scheduler or cfg.scheduler.kind
@@ -229,7 +236,8 @@ def text2image(
     schedule = sched_mod.schedule_from_config(num_steps, cfg.scheduler,
                                               kind=scheduler)
     context_cond = encode_prompts(pipe, prompts, dtype=dtype)
-    context_uncond = encode_prompts(pipe, [""] * len(prompts), dtype=dtype)
+    context_uncond = encode_prompts(
+        pipe, [negative_prompt or ""] * len(prompts), dtype=dtype)
 
     x_t, latents = init_latent(latent, pipe.latent_shape, rng, len(prompts), dtype)
     if progress:

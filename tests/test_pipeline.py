@@ -137,3 +137,20 @@ def test_spatial_replace(tiny_pipe):
     rng = jax.random.PRNGKey(19)
     img, _, _ = text2image(tiny_pipe, PROMPTS, ctrl, rng=rng)
     assert img.shape[0] == 2
+
+
+def test_negative_prompt_changes_output_and_excludes_nulltext(tiny_pipe):
+    """negative_prompt swaps the CFG unconditional text (a capability the
+    reference lacks); it must change the image and be rejected alongside
+    null-text uncond embeddings."""
+    rng = jax.random.PRNGKey(3)
+    base, x_t, _ = text2image(tiny_pipe, ["a cat"], None, num_steps=2, rng=rng)
+    neg, _, _ = text2image(tiny_pipe, ["a cat"], None, num_steps=2,
+                           latent=x_t, negative_prompt="blurry ugly")
+    assert not np.array_equal(np.asarray(base), np.asarray(neg))
+
+    uncond = np.zeros((2, 1, tiny_pipe.config.text.max_length,
+                       tiny_pipe.config.text.hidden_dim), np.float32)
+    with pytest.raises(ValueError):
+        text2image(tiny_pipe, ["a cat"], None, num_steps=2, latent=x_t,
+                   negative_prompt="x", uncond_embeddings=uncond)
