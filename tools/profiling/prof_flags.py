@@ -1,0 +1,78 @@
+"""XLA flag sweep for the SD14 50-step scan + GN/flash validation.
+
+Run when the TPU lease is healthy (each variant re-runs this script in a
+subprocess so XLA_FLAGS take effect at backend init):
+
+    python tools/profiling/prof_flags.py            # sweep driver
+    python tools/profiling/prof_flags.py --inner    # one measurement
+"""
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+VARIANTS = {
+    "baseline": "",
+    "latency_hiding": "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "vmem_128m": "--xla_tpu_scoped_vmem_limit_kib=131072",
+    "async_streams": "--xla_tpu_enable_async_collective_fusion=true",
+}
+
+
+def inner():
+    sys.path.insert(0, REPO)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2p_tpu.models import SD14, init_unet, unet_layout
+    from p2p_tpu.models.unet import apply_unet
+
+    cfg = SD14
+    layout = unet_layout(cfg.unet)
+    params = init_unet(jax.random.PRNGKey(0), cfg.unet)
+    s = cfg.latent_size
+    x = jnp.ones((4, s, s, cfg.unet.in_channels), jnp.bfloat16)
+    ctx = jnp.ones((4, cfg.unet.context_len, cfg.unet.context_dim), jnp.bfloat16)
+
+    @jax.jit
+    def scan(params, x, ctx):
+        def body(h, t):
+            eps, _ = apply_unet(params, cfg.unet, h, t, ctx, layout=layout)
+            return eps, None
+        out, _ = jax.lax.scan(body, x, jnp.arange(50, dtype=jnp.int32))
+        return out
+
+    np.asarray(scan(params, x, ctx))
+    best = 1e9
+    for _ in range(2):
+        t0 = time.perf_counter()
+        np.asarray(scan(params, x, ctx))
+        best = min(best, time.perf_counter() - t0)
+    print(f"RESULT {best / 50 * 1000:.2f} ms/step", flush=True)
+
+
+def main():
+    if "--inner" in sys.argv:
+        inner()
+        return
+    for name, flags in VARIANTS.items():
+        env = dict(os.environ)
+        if flags:
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flags).strip()
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--inner"],
+                env=env, timeout=900, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True).stdout
+        except subprocess.TimeoutExpired:
+            print(f"{name:16s}: TIMEOUT", flush=True)
+            continue
+        line = next((l for l in out.splitlines() if l.startswith("RESULT")), "no result")
+        print(f"{name:16s}: {line}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
