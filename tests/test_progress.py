@@ -1,0 +1,81 @@
+"""Progress/observability layer: reporter semantics and the compiled-loop
+callback path (the tqdm replacement — SURVEY §5 tracing)."""
+
+import io
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from p2p_tpu.utils import progress
+
+
+def test_reporter_renders_monotonic_progress():
+    buf = io.StringIO()
+    r = progress.StepReporter(4, "test", stream=buf)
+    for s in range(4):
+        r(s)
+    out = buf.getvalue()
+    assert "step 4/4" in out
+    assert out.endswith("\n")          # completion newline
+    assert "ms/step" in out            # rate appears after the first delta
+
+
+def test_reporter_drops_out_of_order_callbacks():
+    buf = io.StringIO()
+    r = progress.StepReporter(5, stream=buf)
+    r(3)
+    r(1)   # late async arrival — must not regress the display
+    r(4)
+    assert r._last_step == 4
+    assert "step 2/5" not in buf.getvalue()
+
+
+def test_emit_step_disabled_adds_nothing():
+    """progress=False must leave the compiled program untouched: no host
+    callback (custom-call) appears in the HLO, unlike the enabled variant."""
+    def make(enabled):
+        def f(x):
+            progress.emit_step(enabled, jnp.int32(0))
+            return x * 2.0
+        return jax.jit(f).lower(jnp.ones(4)).compile().as_text()
+
+    assert "custom-call" not in make(False)
+    assert "custom-call" in make(True)
+
+
+def test_emit_step_routes_through_active_reporter():
+    seen = []
+
+    class Spy:
+        def __call__(self, step):
+            seen.append(int(step))
+
+    progress.set_active(Spy())
+    try:
+        @jax.jit
+        def f(x):
+            def body(c, i):
+                progress.emit_step(True, i)
+                return c + 1.0, None
+            out, _ = jax.lax.scan(body, x, jnp.arange(3))
+            return out
+
+        np.asarray(f(jnp.float32(0.0)))
+        jax.effects_barrier()
+    finally:
+        progress.set_active(None)
+    assert sorted(seen) == [0, 1, 2]
+
+
+def test_trace_writes_profile(tmp_path):
+    with progress.trace(str(tmp_path / "tr")):
+        np.asarray(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    files = list((tmp_path / "tr").rglob("*.xplane.pb"))
+    assert files, "profiler trace not written"
+
+
+def test_trace_none_is_noop():
+    with progress.trace(None):
+        pass

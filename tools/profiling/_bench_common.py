@@ -1,0 +1,42 @@
+"""Shared SD14 50-step scan benchmark used by the profiling scripts."""
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def sd14_scan_ms_per_step(batch: int = 4, steps: int = 50, repeats: int = 2) -> float:
+    """Best-of-N ms/step for the jitted SD14 U-Net scan (identity controller)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from p2p_tpu.models import SD14, init_unet, unet_layout
+    from p2p_tpu.models.unet import apply_unet
+
+    cfg = SD14
+    layout = unet_layout(cfg.unet)
+    params = init_unet(jax.random.PRNGKey(0), cfg.unet)
+    s = cfg.latent_size
+    x = jnp.ones((batch, s, s, cfg.unet.in_channels), jnp.bfloat16)
+    ctx = jnp.ones((batch, cfg.unet.context_len, cfg.unet.context_dim),
+                   jnp.bfloat16)
+
+    @jax.jit
+    def scan(params, x, ctx):
+        def body(h, t):
+            eps, _ = apply_unet(params, cfg.unet, h, t, ctx, layout=layout)
+            return eps, None
+        out, _ = jax.lax.scan(body, x, jnp.arange(steps, dtype=jnp.int32))
+        return out
+
+    np.asarray(scan(params, x, ctx))  # compile
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        np.asarray(scan(params, x, ctx))
+        best = min(best, time.perf_counter() - t0)
+    return best / steps * 1000.0

@@ -22,40 +22,14 @@ VARIANTS = {
 
 
 def inner():
-    sys.path.insert(0, REPO)
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+    from _bench_common import sd14_scan_ms_per_step
 
-    from p2p_tpu.models import SD14, init_unet, unet_layout
-    from p2p_tpu.models.unet import apply_unet
-
-    cfg = SD14
-    layout = unet_layout(cfg.unet)
-    params = init_unet(jax.random.PRNGKey(0), cfg.unet)
-    s = cfg.latent_size
-    x = jnp.ones((4, s, s, cfg.unet.in_channels), jnp.bfloat16)
-    ctx = jnp.ones((4, cfg.unet.context_len, cfg.unet.context_dim), jnp.bfloat16)
-
-    @jax.jit
-    def scan(params, x, ctx):
-        def body(h, t):
-            eps, _ = apply_unet(params, cfg.unet, h, t, ctx, layout=layout)
-            return eps, None
-        out, _ = jax.lax.scan(body, x, jnp.arange(50, dtype=jnp.int32))
-        return out
-
-    np.asarray(scan(params, x, ctx))
-    best = 1e9
-    for _ in range(2):
-        t0 = time.perf_counter()
-        np.asarray(scan(params, x, ctx))
-        best = min(best, time.perf_counter() - t0)
-    print(f"RESULT {best / 50 * 1000:.2f} ms/step", flush=True)
+    print(f"RESULT {sd14_scan_ms_per_step():.2f} ms/step", flush=True)
 
 
 def main():
     if "--inner" in sys.argv:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         inner()
         return
     for name, flags in VARIANTS.items():
@@ -70,8 +44,12 @@ def main():
         except subprocess.TimeoutExpired:
             print(f"{name:16s}: TIMEOUT", flush=True)
             continue
-        line = next((l for l in out.splitlines() if l.startswith("RESULT")), "no result")
-        print(f"{name:16s}: {line}", flush=True)
+        line = next((l for l in out.splitlines() if l.startswith("RESULT")), None)
+        if line is None:
+            tail = "\n    ".join(out.splitlines()[-5:])
+            print(f"{name:16s}: FAILED —\n    {tail}", flush=True)
+        else:
+            print(f"{name:16s}: {line}", flush=True)
 
 
 if __name__ == "__main__":
