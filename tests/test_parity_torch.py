@@ -214,3 +214,109 @@ def test_timestep_embedding_matches_torch_oracle():
         want = torch.cat([torch.cos(args), torch.sin(args)], dim=-1).numpy()
     got = np.asarray(jnn.timestep_embedding(jnp.asarray(t), dim))
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_full_unet_matches_torch_oracle():
+    """Whole-model composition oracle: conv_in → down(resnet[+attn], skips,
+    downsample) → mid → up(skip-concat, resnet[+attn], upsample) → out, with
+    the sinusoidal→MLP time path — written against diffusers'
+    UNet2DConditionModel wiring, independent of apply_unet's traversal. This
+    catches wiring bugs (skip order, pad mode, upsample placement) that
+    block-level oracles cannot."""
+    import math
+
+    from p2p_tpu.models.config import TINY_UNET, unet_layout
+    from p2p_tpu.models.unet import apply_unet, init_unet
+
+    cfg = TINY_UNET
+    params = init_unet(jax.random.PRNGKey(21), cfg)
+    layout = unet_layout(cfg)
+    rng = np.random.RandomState(7)
+    b = 2
+    x = rng.randn(b, cfg.sample_size, cfg.sample_size,
+                  cfg.in_channels).astype(np.float32)
+    ctx = rng.randn(b, cfg.context_len, cfg.context_dim).astype(np.float32)
+    t_val = 500
+
+    got, _ = apply_unet(params, cfg, jnp.asarray(x), jnp.int32(t_val),
+                        jnp.asarray(ctx), layout=layout)
+    got = np.asarray(got)
+
+    with torch.no_grad():
+        xt = _to_t(x).permute(0, 3, 1, 2)
+        ct = _to_t(ctx)
+        g = cfg.groups
+
+        # Time path: [cos|sin] sinusoid → linear → silu → linear.
+        half = cfg.block_channels[0] // 2
+        freqs = torch.exp(-math.log(10000.0) * torch.arange(half) / half)
+        args = torch.full((b, 1), float(t_val)) * freqs[None]
+        sin_emb = torch.cat([torch.cos(args), torch.sin(args)], dim=-1)
+        temb = _torch_linear(params["time_fc2"])(
+            torch.nn.functional.silu(_torch_linear(params["time_fc1"])(sin_emb)))
+
+        def resnet(p, h):
+            r = _torch_conv(p["conv1"])(torch.nn.functional.silu(
+                _torch_groupnorm(p["norm1"], g)(h)))
+            r = r + _torch_linear(p["time_proj"])(
+                torch.nn.functional.silu(temb))[:, :, None, None]
+            r = _torch_conv(p["conv2"])(torch.nn.functional.silu(
+                _torch_groupnorm(p["norm2"], g)(r)))
+            skip = _torch_conv(p["skip"], padding=0)(h) if "skip" in p else h
+            return skip + r
+
+        def spatial_transformer(p, h, heads):
+            bb, cc, hh, ww = h.shape
+            res = h
+            y = _torch_groupnorm(p["norm"], g, eps=1e-6)(h)
+            y = y.permute(0, 2, 3, 1).reshape(bb, hh * ww, cc)
+            y = _torch_linear({k: v[0, 0] if k == "kernel" else v
+                               for k, v in p["proj_in"].items()})(y)
+            for blk in p["blocks"]:
+                h1 = _torch_layernorm(blk["ln1"])(y)
+                y = y + _torch_attention(blk["attn1"], h1, h1, heads)
+                y = y + _torch_attention(blk["attn2"],
+                                         _torch_layernorm(blk["ln2"])(y), ct, heads)
+                ff = _torch_linear(blk["ff_in"])(_torch_layernorm(blk["ln3"])(y))
+                val, gate = ff.chunk(2, dim=-1)
+                y = y + _torch_linear(blk["ff_out"])(
+                    val * torch.nn.functional.gelu(gate))
+            y = _torch_linear({k: v[0, 0] if k == "kernel" else v
+                               for k, v in p["proj_out"].items()})(y)
+            return y.reshape(bb, hh, ww, cc).permute(0, 3, 1, 2) + res
+
+        h = _torch_conv(params["conv_in"])(xt)
+        skips = [h]
+        for level, block in enumerate(params["down"]):
+            heads = cfg.heads_for(cfg.block_channels[level])
+            for i, rp in enumerate(block["resnets"]):
+                h = resnet(rp, h)
+                if block["attns"]:
+                    h = spatial_transformer(block["attns"][i], h, heads)
+                skips.append(h)
+            if "downsample" in block:
+                h = _torch_conv(block["downsample"], stride=2, padding=1)(h)
+                skips.append(h)
+
+        mid_heads = cfg.heads_for(cfg.block_channels[-1])
+        h = resnet(params["mid"]["resnet1"], h)
+        h = spatial_transformer(params["mid"]["attn"], h, mid_heads)
+        h = resnet(params["mid"]["resnet2"], h)
+
+        for pos, block in enumerate(params["up"]):
+            level = cfg.levels - 1 - pos
+            heads = cfg.heads_for(cfg.block_channels[level])
+            for i, rp in enumerate(block["resnets"]):
+                h = torch.cat([h, skips.pop()], dim=1)
+                h = resnet(rp, h)
+                if block["attns"]:
+                    h = spatial_transformer(block["attns"][i], h, heads)
+            if "upsample" in block:
+                h = torch.nn.functional.interpolate(h, scale_factor=2,
+                                                    mode="nearest")
+                h = _torch_conv(block["upsample"])(h)
+
+        h = torch.nn.functional.silu(_torch_groupnorm(params["norm_out"], g)(h))
+        want = _torch_conv(params["conv_out"])(h).permute(0, 2, 3, 1).numpy()
+
+    np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-3)

@@ -1,4 +1,6 @@
-"""Shared SD14 50-step scan benchmark used by the profiling scripts."""
+"""Shared SD14 50-step scan benchmark (currently used by prof_flags.py; the
+other prof_* scripts are frozen records of specific round-2 experiments —
+their inline copies document exactly what was measured then)."""
 import os
 import sys
 import time

@@ -9,9 +9,6 @@ subprocess so XLA_FLAGS take effect at backend init):
 import os
 import subprocess
 import sys
-import time
-
-REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 VARIANTS = {
     "baseline": "",
