@@ -320,3 +320,78 @@ def test_full_unet_matches_torch_oracle():
         want = _torch_conv(params["conv_out"])(h).permute(0, 2, 3, 1).numpy()
 
     np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-3)
+
+
+def test_full_vae_matches_torch_oracle():
+    """Whole-VAE composition oracle (diffusers AutoencoderKL wiring): encoder
+    with asymmetric (0,1)/(0,1) pre-pad before stride-2 downsamples and
+    single-head mid attention, quant/post-quant convs, nearest-x2 decoder —
+    encode posterior mean and decode must match `models/vae.py` exactly."""
+    from p2p_tpu.models import vae as vae_mod
+    from p2p_tpu.models.config import TINY_VAE
+
+    cfg = TINY_VAE
+    params = vae_mod.init_vae(jax.random.PRNGKey(31), cfg)
+    g = cfg.groups
+    rng = np.random.RandomState(9)
+    image = rng.randn(2, 64, 64, cfg.in_channels).astype(np.float32) * 0.5
+
+    got_lat = np.asarray(vae_mod.encode(params, cfg, jnp.asarray(image)))
+    got_img = np.asarray(vae_mod.decode(params, cfg, jnp.asarray(got_lat)))
+
+    with torch.no_grad():
+        def resnet(p, h):
+            r = _torch_conv(p["conv1"])(torch.nn.functional.silu(
+                _torch_groupnorm(p["norm1"], g)(h)))
+            r = _torch_conv(p["conv2"])(torch.nn.functional.silu(
+                _torch_groupnorm(p["norm2"], g)(r)))
+            skip = _torch_conv(p["skip"], padding=0)(h) if "skip" in p else h
+            return skip + r
+
+        def mid_attn(p, h):
+            bb, cc, hh, ww = h.shape
+            y = _torch_groupnorm(p["norm"], g)(h)
+            y = y.permute(0, 2, 3, 1).reshape(bb, hh * ww, cc)
+            q = _torch_linear(p["q"])(y)
+            k = _torch_linear(p["k"])(y)
+            v = _torch_linear(p["v"])(y)
+            attn = torch.softmax(q @ k.transpose(-1, -2) * cc ** -0.5, dim=-1)
+            out = _torch_linear(p["out"])(attn @ v)
+            return h + out.reshape(bb, hh, ww, cc).permute(0, 3, 1, 2)
+
+        enc = params["encoder"]
+        h = _torch_conv(enc["conv_in"])(_to_t(image).permute(0, 3, 1, 2))
+        for block in enc["down"]:
+            for rp in block["resnets"]:
+                h = resnet(rp, h)
+            if "downsample" in block:
+                h = torch.nn.functional.pad(h, (0, 1, 0, 1))
+                h = _torch_conv(block["downsample"], stride=2, padding=0)(h)
+        h = resnet(enc["mid"]["resnet1"], h)
+        h = mid_attn(enc["mid"]["attn"], h)
+        h = resnet(enc["mid"]["resnet2"], h)
+        h = _torch_conv(enc["conv_out"])(torch.nn.functional.silu(
+            _torch_groupnorm(enc["norm_out"], g)(h)))
+        moments = _torch_conv(enc["quant_conv"], padding=0)(h)
+        mean = moments[:, :cfg.latent_channels]
+        want_lat = (mean * cfg.scaling_factor).permute(0, 2, 3, 1).numpy()
+
+        dec = params["decoder"]
+        z = _to_t(got_lat).permute(0, 3, 1, 2) / cfg.scaling_factor
+        h = _torch_conv(dec["post_quant_conv"], padding=0)(z)
+        h = _torch_conv(dec["conv_in"])(h)
+        h = resnet(dec["mid"]["resnet1"], h)
+        h = mid_attn(dec["mid"]["attn"], h)
+        h = resnet(dec["mid"]["resnet2"], h)
+        for block in dec["up"]:
+            for rp in block["resnets"]:
+                h = resnet(rp, h)
+            if "upsample" in block:
+                h = torch.nn.functional.interpolate(h, scale_factor=2,
+                                                    mode="nearest")
+                h = _torch_conv(block["upsample"])(h)
+        h = torch.nn.functional.silu(_torch_groupnorm(dec["norm_out"], g)(h))
+        want_img = _torch_conv(dec["conv_out"])(h).permute(0, 2, 3, 1).numpy()
+
+    np.testing.assert_allclose(got_lat, want_lat, atol=3e-5, rtol=1e-3)
+    np.testing.assert_allclose(got_img, want_img, atol=3e-5, rtol=1e-3)
