@@ -126,8 +126,10 @@ def replacement_mapper_single(src: str, tgt: str, tokenizer: Tokenizer,
     token spans cross-connect (weight ``1/len(target_span)`` when span sizes
     differ), everything else is identity
     (`/root/reference/seq_aligner.py:152-185`). Rows index source tokens,
-    columns index edit-prompt tokens, so columns sum to 1 over each source
-    span — attention rows stay normalized after the projection.
+    columns index edit-prompt tokens; each source-token ROW carries unit
+    mass (block weight 1/len(target) over len(target) columns), so
+    ``attn @ m`` preserves total attention mass — except for the reference's
+    shrinking-span trailing quirk noted below.
     """
     words_x = src.split(" ")
     words_y = tgt.split(" ")
@@ -160,7 +162,13 @@ def replacement_mapper_single(src: str, tgt: str, tokenizer: Tokenizer,
             j += 1
         else:
             # Past the last replaced span the reference switches to a pure
-            # diagonal keyed by the *target* index (`seq_aligner.py:181`).
+            # diagonal keyed by the *target* index (`seq_aligner.py:179-182`:
+            # ``mapper[j, j] = 1``). NOTE: when a replaced source span is
+            # longer than its target span this diagonal overlaps rows the
+            # span block already used (row sums then exceed 1 and trailing
+            # same-word tokens misalign by the length difference) — a quirk
+            # of the reference we reproduce bit-for-bit for pixel parity;
+            # it is pinned in tests/test_align_properties.py.
             mapper[j, j] = 1.0
             i += 1
             j += 1
