@@ -126,10 +126,12 @@ def replacement_mapper_single(src: str, tgt: str, tokenizer: Tokenizer,
     token spans cross-connect (weight ``1/len(target_span)`` when span sizes
     differ), everything else is identity
     (`/root/reference/seq_aligner.py:152-185`). Rows index source tokens,
-    columns index edit-prompt tokens; each source-token ROW carries unit
-    mass (block weight 1/len(target) over len(target) columns), so
-    ``attn @ m`` preserves total attention mass — except for the reference's
-    shrinking-span trailing quirk noted below.
+    columns index edit-prompt tokens; when every swapped word keeps its
+    token count, each source-token ROW carries unit mass and ``attn @ m``
+    preserves total attention mass. When a swapped word's token count
+    CHANGES, the reference's trailing diagonal (noted below) misaligns the
+    tail: shrinking spans double-count rows (mass > 1), growing spans skip
+    rows (mass 0) — both reproduced bit-for-bit for pixel parity.
     """
     words_x = src.split(" ")
     words_y = tgt.split(" ")

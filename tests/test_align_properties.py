@@ -127,3 +127,13 @@ def test_shrinking_span_reproduces_reference_trailing_quirk():
     # word's column and the trailing diagonal:
     assert m[2, 1] == 1.0 and m[2, 2] == 1.0
     assert m[2].sum() == 2.0
+
+
+def test_growing_span_drops_trailing_source_row_like_reference():
+    """Dual of the shrinking-span quirk: a growing target span makes the
+    reference's trailing diagonal skip source rows entirely (mass 0)."""
+    t = tok()
+    src, dst = "cat hat", "extraordinarily hat"
+    m = get_replacement_mapper([src, dst], t, max_len=8)[0]
+    sums = m[:5].sum(axis=1)
+    assert sums[2] == 0.0  # source 'hat' row dropped, as in the reference

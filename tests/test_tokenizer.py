@@ -206,3 +206,39 @@ def test_bert_per_token_decode_strips_to_word_pieces(bert_pair):
     ids = ours.encode("jumping")
     pieces = [ours.decode([t]) for t in ids[1:-1]]
     assert pieces == ["jump", "##ing"]
+
+
+# ---------------------------------------------------------------------------
+# Hypothesis fuzz: arbitrary unicode must tokenize identically to HF
+# ---------------------------------------------------------------------------
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+_text = st.text(
+    alphabet=st.characters(
+        codec="utf-8",
+        categories=("L", "N", "P", "S", "Z", "M"),  # letters .. marks
+    ),
+    max_size=60,
+)
+
+
+@settings(max_examples=60, deadline=None)
+@given(_text)
+def test_clip_fuzz_matches_hf(tok_pair, text):
+    hf, ours = tok_pair
+    got = ours(text, max_length=77)["input_ids"][0]
+    want = hf(text, padding="max_length", max_length=77,
+              truncation=True)["input_ids"]
+    assert got == want, repr(text)
+
+
+@settings(max_examples=60, deadline=None)
+@given(_text)
+def test_bert_fuzz_matches_hf(bert_pair, text):
+    hf, ours = bert_pair
+    got = ours(text, max_length=77)["input_ids"][0]
+    want = hf(text, padding="max_length", max_length=77,
+              truncation=True)["input_ids"]
+    assert got == want, repr(text)
