@@ -18,7 +18,7 @@ torch Conv stores (O, I, kH, kW) — ours is HWIO.
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Dict, Iterator, List, Tuple
+from typing import Any, Dict, List, Tuple
 
 import numpy as np
 

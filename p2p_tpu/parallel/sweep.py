@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..controllers.base import AttnLayout, Controller, init_store_state
+from ..controllers.base import AttnLayout, Controller
 from ..engine.sampler import _denoise_scan
 from ..models import vae as vae_mod
 from ..models.config import PipelineConfig
