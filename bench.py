@@ -100,6 +100,10 @@ def _measure(preset):
     import jax.numpy as jnp
     import numpy as np
 
+    from p2p_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
     from p2p_tpu.controllers import factory
     from p2p_tpu.engine.sampler import Pipeline, text2image
     from p2p_tpu.models import SD14, TINY, init_text_encoder, init_unet

@@ -285,6 +285,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from .utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
     return args.fn(args)
 
 

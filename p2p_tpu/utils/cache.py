@@ -1,0 +1,55 @@
+"""Persistent XLA compile cache shared by every entry point.
+
+The SD-1.4 sampling program takes minutes of host-side XLA compilation; the
+reference pays the analogous torch/diffusers warmup every process start. With
+a persistent cache, bench.py / the CLI / the profiling tools compile each
+distinct program once per machine and reload it afterwards (works for both
+the CPU and TPU backends; keyed on HLO + compile options + backend).
+
+tests/conftest.py sets the same directory via env vars before ``import jax``;
+this helper is the post-import equivalent for non-test entry points.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+
+import jax
+
+_DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".jax_cache")
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (defaults to
+    ``<repo>/.jax_cache``, gitignored). Safe to call more than once.
+
+    Not every XLA flag reaches the cache key, so the ambient ``XLA_FLAGS``
+    value is hashed into the directory name — two processes with different
+    codegen flags can never reload each other's executables. The
+    ``JAX_PERSISTENT_CACHE_*`` env knobs are honored when set. The cache is a
+    pure optimization: any failure to set it up is reported and skipped.
+    """
+    cache_dir = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if cache_dir is None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        suffix = ("-" + hashlib.sha256(flags.encode()).hexdigest()[:12]
+                  if flags else "")
+        cache_dir = _DEFAULT_DIR + suffix
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", 1.0)))
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes",
+            int(os.environ.get("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", 0)))
+    except Exception as e:  # cache must never take an entry point down
+        print(f"persistent compile cache disabled ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        return None
+    return cache_dir

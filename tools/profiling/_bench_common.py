@@ -18,6 +18,9 @@ def sd14_scan_ms_per_step(batch: int = 4, steps: int = 50, repeats: int = 2) -> 
 
     from p2p_tpu.models import SD14, init_unet, unet_layout
     from p2p_tpu.models.unet import apply_unet
+    from p2p_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
 
     cfg = SD14
     layout = unet_layout(cfg.unet)

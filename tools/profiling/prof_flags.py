@@ -33,6 +33,10 @@ def main():
         env = dict(os.environ)
         if flags:
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flags).strip()
+        # Per-variant cache isolation: enable_persistent_cache hashes the
+        # variant's XLA_FLAGS into the cache directory name — but only on
+        # its default path, so drop any inherited explicit cache dir.
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
         try:
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--inner"],
