@@ -82,10 +82,18 @@ def main():
     preset = args.preset
     result = None
     if preset != "tiny" and _probe_accelerator():
-        result = _run_inner("sd14", dict(os.environ), timeout=1800)
-        if result is None:  # one retry: transient lease wedges do clear
+        # First attempt gets the long leash: a cold compile of the SD-1.4
+        # program is minutes of single-core XLA work before any step runs.
+        t0 = time.time()
+        result = _run_inner("sd14", dict(os.environ), timeout=2400)
+        if result is None:
+            # Retry once. A fast failure (crash, OOM) gets the full leash
+            # again; a timeout-shaped failure gets a short one — the compile
+            # is now in the persistent cache, so a healthy lease finishes in
+            # minutes and a still-wedged lease shouldn't eat another 40.
             time.sleep(30)
-            result = _run_inner("sd14", dict(os.environ), timeout=1800)
+            retry_timeout = 2400 if time.time() - t0 < 600 else 900
+            result = _run_inner("sd14", dict(os.environ), timeout=retry_timeout)
     if result is None:
         result = _run_inner("tiny", _cpu_env(), timeout=900)
     if result is None:
@@ -186,7 +194,10 @@ def _measure(preset):
                   if on_accel else "tiny_cpu_fallback_imgs_per_s",
         "value": round(imgs_per_s, 4),
         "unit": "img/s/chip",
-        "vs_baseline": round(imgs_per_s / baseline, 4),
+        # The baseline is defined for the SD-1.4 TPU workload; a tiny-model
+        # CPU fallback rate is not comparable to it, so report 0 rather than
+        # a meaningless (and flattering) ratio.
+        "vs_baseline": round(imgs_per_s / baseline, 4) if on_accel else 0.0,
         "variant": variant,
     }))
     return 0

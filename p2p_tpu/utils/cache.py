@@ -40,14 +40,17 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
                   if flags else "")
         cache_dir = _DEFAULT_DIR + suffix
     try:
+        # Parse everything before the first config.update so the settings
+        # apply all-or-nothing (a late parse error must not leave the cache
+        # half-enabled while we report it disabled).
+        min_secs = float(
+            os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", 1.0))
+        min_bytes = int(
+            os.environ.get("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", 0))
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update(
-            "jax_persistent_cache_min_compile_time_secs",
-            float(os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", 1.0)))
-        jax.config.update(
-            "jax_persistent_cache_min_entry_size_bytes",
-            int(os.environ.get("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", 0)))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", min_secs)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", min_bytes)
     except Exception as e:  # cache must never take an entry point down
         print(f"persistent compile cache disabled ({type(e).__name__}: {e})",
               file=sys.stderr)

@@ -15,6 +15,8 @@ VARIANTS = {
     "latency_hiding": "--xla_tpu_enable_latency_hiding_scheduler=true",
     "vmem_128m": "--xla_tpu_scoped_vmem_limit_kib=131072",
     "async_streams": "--xla_tpu_enable_async_collective_fusion=true",
+    "latency_vmem": ("--xla_tpu_enable_latency_hiding_scheduler=true "
+                     "--xla_tpu_scoped_vmem_limit_kib=131072"),
 }
 
 
