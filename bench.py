@@ -47,15 +47,19 @@ def _probe_accelerator(timeout=180, attempts=3, backoffs=(15, 45)):
     return False
 
 
+_TIMEOUT = object()  # sentinel: the inner subprocess hit its timeout
+
+
 def _run_inner(preset, env, timeout):
-    """Run the measurement subprocess; return the parsed JSON line or None."""
+    """Run the measurement subprocess; return the parsed JSON line, None on
+    a non-timeout failure, or the _TIMEOUT sentinel."""
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--inner", preset],
             env=env, timeout=timeout, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
     except subprocess.TimeoutExpired:
-        return None
+        return _TIMEOUT
     for line in reversed(proc.stdout.splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -84,19 +88,19 @@ def main():
     if preset != "tiny" and _probe_accelerator():
         # First attempt gets the long leash: a cold compile of the SD-1.4
         # program is minutes of single-core XLA work before any step runs.
-        t0 = time.time()
         result = _run_inner("sd14", dict(os.environ), timeout=2400)
-        if result is None:
-            # Retry once. A fast failure (crash, OOM) gets the full leash
-            # again; a timeout-shaped failure gets a short one — the compile
-            # is now in the persistent cache, so a healthy lease finishes in
-            # minutes and a still-wedged lease shouldn't eat another 40.
+        if result is _TIMEOUT or result is None:
+            # Retry once. A crash/OOM gets the full leash again; an actual
+            # timeout gets a short one — a healthy lease finishes in minutes
+            # off the now-warm persistent compile cache, and a still-wedged
+            # lease shouldn't eat another 40.
+            retry_timeout = 900 if result is _TIMEOUT else 2400
             time.sleep(30)
-            retry_timeout = 2400 if time.time() - t0 < 600 else 900
-            result = _run_inner("sd14", dict(os.environ), timeout=retry_timeout)
-    if result is None:
+            result = _run_inner("sd14", dict(os.environ),
+                                timeout=retry_timeout)
+    if result is _TIMEOUT or result is None:
         result = _run_inner("tiny", _cpu_env(), timeout=900)
-    if result is None:
+    if result is _TIMEOUT or result is None:
         result = {"metric": "backend_unavailable", "value": 0.0,
                   "unit": "img/s/chip", "vs_baseline": 0.0}
     print(json.dumps(result))

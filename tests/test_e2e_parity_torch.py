@@ -1,0 +1,365 @@
+"""End-to-end sampling-loop parity vs a hand-rolled torch reference pipeline.
+
+The module-level oracles (tests/test_parity_torch.py) prove each block; this
+test proves the *composition* the north star calls "pixel-matching the PyTorch
+reference": tokenize → CLIP text encode → CFG batch-doubling → per-layer
+attention hook applying AttentionReplace → DDIM update → VAE decode → uint8,
+run once through our jitted `text2image` and once through an independent torch
+loop written against the reference's semantics:
+
+- loop structure and CFG combine: `/root/reference/ptp_utils.py:65-76,129-172`
+- controller math: `/root/reference/main.py:85-98,162-230` (cond-half-only
+  edits, cross alpha-schedule blend, self-injection window)
+- edit precompute: the reference's OWN `seq_aligner.get_replacement_mapper`
+  and `ptp_utils.get_time_words_attention_alpha` (imported from
+  /root/reference, torch CPU) with the same tokenizer on both sides
+- DDIM update: closed form of `/root/reference/null_text.py:471-480` with
+  set_alpha_to_one=False semantics
+- decode: `/root/reference/ptp_utils.py:79-85`
+
+Weights are shared: random-init OUR params, consumed directly by the torch
+oracle modules (and through `export_state_dict` for the CLIP text tower).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from p2p_tpu.controllers import factory
+from p2p_tpu.engine.sampler import Pipeline, text2image
+from p2p_tpu.models import TINY, init_text_encoder, init_unet
+from p2p_tpu.models import vae as vae_mod
+from p2p_tpu.models.checkpoint import export_state_dict, text_encoder_entries
+from p2p_tpu.ops import schedulers as sched_mod
+from p2p_tpu.utils.tokenizer import HashWordTokenizer, pad_ids
+
+from test_parity_torch import (
+    _to_t,
+    _torch_conv,
+    _torch_groupnorm,
+    _torch_layernorm,
+    _torch_linear,
+)
+
+REFERENCE_DIR = "/root/reference"
+
+NUM_STEPS = 3
+GUIDANCE = 7.5
+CROSS_REPLACE = 0.8
+SELF_REPLACE = 0.5
+SELF_MAX_PIXELS = 16 * 16
+
+# One prompt pair per edit kind: same word count for Replace/Reweight, a word
+# insertion for Refine (NW-aligned gather path).
+PROMPTS_BY_MODE = {
+    "replace": ["a cat riding a bike", "a dog riding a bike"],
+    "refine": ["a cat riding a bike", "a fluffy cat riding a bike"],
+    "reweight_on_replace": ["a cat riding a bike", "a dog riding a bike"],
+}
+
+
+def _reference_modules():
+    if not os.path.isdir(REFERENCE_DIR):
+        pytest.skip("reference checkout not available")
+    sys.path.insert(0, REFERENCE_DIR)
+    try:
+        import ptp_utils as ref_ptp
+        import seq_aligner as ref_aligner
+    except Exception as e:  # pragma: no cover
+        pytest.skip(f"reference import failed: {e}")
+    finally:
+        sys.path.remove(REFERENCE_DIR)
+    return ref_ptp, ref_aligner
+
+
+def _torch_attention(p, x, context, heads, hook=None, is_cross=None):
+    """diffusers CrossAttention forward with the reference's probability hook
+    (`/root/reference/ptp_utils.py:183-208`): softmax(QKᵀ·s) routed through
+    the controller before the V product."""
+    q = _torch_linear(p["to_q"])(x)
+    k = _torch_linear(p["to_k"])(context)
+    v = _torch_linear(p["to_v"])(context)
+    b, s_q, d = q.shape
+    dh = d // heads
+
+    def split(t):
+        return t.reshape(b, -1, heads, dh).permute(0, 2, 1, 3)
+
+    q, k, v = split(q), split(k), split(v)
+    attn = torch.softmax(q @ k.transpose(-1, -2) * dh ** -0.5, dim=-1)
+    if hook is not None:
+        attn = hook(attn, is_cross)
+    out = (attn @ v).permute(0, 2, 1, 3).reshape(b, s_q, d)
+    return _torch_linear(p["to_out"])(out)
+
+
+def _torch_unet(params, cfg, xt, t_val, ct, hook):
+    """Full U-Net composition oracle (same wiring as
+    tests/test_parity_torch.py::test_full_unet_matches_torch_oracle) with the
+    attention hook threaded through every site in call order."""
+    import math
+
+    b = xt.shape[0]
+    g = cfg.groups
+
+    half = cfg.block_channels[0] // 2
+    freqs = torch.exp(-math.log(10000.0) * torch.arange(half) / half)
+    args = torch.full((b, 1), float(t_val)) * freqs[None]
+    sin_emb = torch.cat([torch.cos(args), torch.sin(args)], dim=-1)
+    temb = _torch_linear(params["time_fc2"])(
+        torch.nn.functional.silu(_torch_linear(params["time_fc1"])(sin_emb)))
+
+    def resnet(p, h):
+        r = _torch_conv(p["conv1"])(torch.nn.functional.silu(
+            _torch_groupnorm(p["norm1"], g)(h)))
+        r = r + _torch_linear(p["time_proj"])(
+            torch.nn.functional.silu(temb))[:, :, None, None]
+        r = _torch_conv(p["conv2"])(torch.nn.functional.silu(
+            _torch_groupnorm(p["norm2"], g)(r)))
+        skip = _torch_conv(p["skip"], padding=0)(h) if "skip" in p else h
+        return skip + r
+
+    def spatial_transformer(p, h, heads):
+        bb, cc, hh, ww = h.shape
+        res = h
+        y = _torch_groupnorm(p["norm"], g, eps=1e-6)(h)
+        y = y.permute(0, 2, 3, 1).reshape(bb, hh * ww, cc)
+        y = _torch_linear({k: v[0, 0] if k == "kernel" else v
+                           for k, v in p["proj_in"].items()})(y)
+        for blk in p["blocks"]:
+            h1 = _torch_layernorm(blk["ln1"])(y)
+            y = y + _torch_attention(blk["attn1"], h1, h1, heads,
+                                     hook=hook, is_cross=False)
+            y = y + _torch_attention(blk["attn2"],
+                                     _torch_layernorm(blk["ln2"])(y), ct, heads,
+                                     hook=hook, is_cross=True)
+            ff = _torch_linear(blk["ff_in"])(_torch_layernorm(blk["ln3"])(y))
+            val, gate = ff.chunk(2, dim=-1)
+            y = y + _torch_linear(blk["ff_out"])(
+                val * torch.nn.functional.gelu(gate))
+        y = _torch_linear({k: v[0, 0] if k == "kernel" else v
+                           for k, v in p["proj_out"].items()})(y)
+        return y.reshape(bb, hh, ww, cc).permute(0, 3, 1, 2) + res
+
+    h = _torch_conv(params["conv_in"])(xt)
+    skips = [h]
+    for level, block in enumerate(params["down"]):
+        heads = cfg.heads_for(cfg.block_channels[level])
+        for i, rp in enumerate(block["resnets"]):
+            h = resnet(rp, h)
+            if block["attns"]:
+                h = spatial_transformer(block["attns"][i], h, heads)
+            skips.append(h)
+        if "downsample" in block:
+            h = _torch_conv(block["downsample"], stride=2, padding=1)(h)
+            skips.append(h)
+
+    mid_heads = cfg.heads_for(cfg.block_channels[-1])
+    h = resnet(params["mid"]["resnet1"], h)
+    h = spatial_transformer(params["mid"]["attn"], h, mid_heads)
+    h = resnet(params["mid"]["resnet2"], h)
+
+    for pos, block in enumerate(params["up"]):
+        level = cfg.levels - 1 - pos
+        heads = cfg.heads_for(cfg.block_channels[level])
+        for i, rp in enumerate(block["resnets"]):
+            h = torch.cat([h, skips.pop()], dim=1)
+            h = resnet(rp, h)
+            if block["attns"]:
+                h = spatial_transformer(block["attns"][i], h, heads)
+        if "upsample" in block:
+            h = torch.nn.functional.interpolate(h, scale_factor=2,
+                                                mode="nearest")
+            h = _torch_conv(block["upsample"])(h)
+
+    h = torch.nn.functional.silu(_torch_groupnorm(params["norm_out"], g)(h))
+    return _torch_conv(params["conv_out"])(h)
+
+
+def _torch_vae_decode(params, cfg, z):
+    """Decoder half of the VAE composition oracle
+    (tests/test_parity_torch.py::test_full_vae_matches_torch_oracle)."""
+    g = cfg.groups
+
+    def resnet(p, h):
+        r = _torch_conv(p["conv1"])(torch.nn.functional.silu(
+            _torch_groupnorm(p["norm1"], g)(h)))
+        r = _torch_conv(p["conv2"])(torch.nn.functional.silu(
+            _torch_groupnorm(p["norm2"], g)(r)))
+        skip = _torch_conv(p["skip"], padding=0)(h) if "skip" in p else h
+        return skip + r
+
+    def mid_attn(p, h):
+        bb, cc, hh, ww = h.shape
+        y = _torch_groupnorm(p["norm"], g)(h)
+        y = y.permute(0, 2, 3, 1).reshape(bb, hh * ww, cc)
+        q = _torch_linear(p["q"])(y)
+        k = _torch_linear(p["k"])(y)
+        v = _torch_linear(p["v"])(y)
+        attn = torch.softmax(q @ k.transpose(-1, -2) * cc ** -0.5, dim=-1)
+        out = _torch_linear(p["out"])(attn @ v)
+        return h + out.reshape(bb, hh, ww, cc).permute(0, 3, 1, 2)
+
+    dec = params["decoder"]
+    h = _torch_conv(dec["post_quant_conv"], padding=0)(z / cfg.scaling_factor)
+    h = _torch_conv(dec["conv_in"])(h)
+    h = resnet(dec["mid"]["resnet1"], h)
+    h = mid_attn(dec["mid"]["attn"], h)
+    h = resnet(dec["mid"]["resnet2"], h)
+    for block in dec["up"]:
+        for rp in block["resnets"]:
+            h = resnet(rp, h)
+        if "upsample" in block:
+            h = torch.nn.functional.interpolate(h, scale_factor=2,
+                                                mode="nearest")
+            h = _torch_conv(block["upsample"])(h)
+    h = torch.nn.functional.silu(_torch_groupnorm(dec["norm_out"], g)(h))
+    return _torch_conv(dec["conv_out"])(h)
+
+
+@pytest.mark.parametrize("mode", list(PROMPTS_BY_MODE))
+def test_text2image_matches_torch_pipeline(mode):
+    cfg = TINY
+    tok = HashWordTokenizer(model_max_length=cfg.text.max_length)
+    L = cfg.unet.context_len
+    prompts = PROMPTS_BY_MODE[mode]
+    pipe = Pipeline(
+        config=cfg,
+        unet_params=init_unet(jax.random.PRNGKey(0), cfg.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(1), cfg.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae),
+        tokenizer=tok,
+    )
+    x_t = jax.random.normal(jax.random.PRNGKey(5),
+                            (1,) + pipe.latent_shape, jnp.float32)
+
+    ref_ptp, ref_aligner = _reference_modules()
+
+    # Equalizer for the reweight mode: scale the swapped word's tokens, index
+    # computed by the reference's own get_word_inds.
+    equalizer = None
+    if mode == "reweight_on_replace":
+        equalizer = np.ones((1, L), np.float32)
+        inds = ref_ptp.get_word_inds(prompts[1], "dog", tok)
+        equalizer[:, inds] = 2.0
+
+    # --- ours: one jitted program -------------------------------------------
+    kwargs = dict(cross_replace_steps=CROSS_REPLACE,
+                  self_replace_steps=SELF_REPLACE, tokenizer=tok,
+                  self_max_pixels=SELF_MAX_PIXELS, max_len=L)
+    if mode == "replace":
+        controller = factory.attention_replace(prompts, NUM_STEPS, **kwargs)
+    elif mode == "refine":
+        controller = factory.attention_refine(prompts, NUM_STEPS, **kwargs)
+    else:
+        base_ctrl = factory.attention_replace(prompts, NUM_STEPS, **kwargs)
+        controller = factory.attention_reweight(
+            prompts, NUM_STEPS, equalizer=jnp.asarray(equalizer),
+            base=base_ctrl, **kwargs)
+    got_img, _, _ = text2image(pipe, prompts, controller, num_steps=NUM_STEPS,
+                               guidance_scale=GUIDANCE, scheduler="ddim",
+                               latent=x_t)
+    got_img = np.asarray(got_img)
+
+    # --- torch: the reference pipeline, hand-rolled --------------------------
+    # Edit precompute by the reference's own host-side functions.
+    cross_alpha = ref_ptp.get_time_words_attention_alpha(
+        prompts, NUM_STEPS, CROSS_REPLACE, tok, max_num_words=L).float()
+    if mode == "refine":
+        mapper, refine_alphas = ref_aligner.get_refinement_mapper(
+            prompts, tok, max_len=L)
+        refine_alphas = refine_alphas.float().reshape(
+            refine_alphas.shape[0], 1, 1, refine_alphas.shape[1])
+    else:
+        mapper = ref_aligner.get_replacement_mapper(
+            prompts, tok, max_len=L).float()
+    eq_t = None if equalizer is None else torch.from_numpy(equalizer)
+    self_lo, self_hi = 0, int(NUM_STEPS * SELF_REPLACE)
+
+    def make_hook(step):
+        def hook(attn, is_cross):
+            # Cond-half-only edits (`/root/reference/main.py:90-92`): the CFG
+            # batch is [uncond(B); cond(B)], prompt 0 is the source.
+            b = attn.shape[0] // 2
+            cond = attn[b:]
+            base, edits = cond[:1], cond[1:]
+            if is_cross:
+                if mode == "refine":
+                    # Gather + existed-token blend (`/root/reference/main.py:235-239`).
+                    new = base[0][:, :, mapper].permute(2, 0, 1, 3)
+                    new = new * refine_alphas + edits * (1.0 - refine_alphas)
+                else:
+                    new = torch.einsum("hpw,bwn->bhpn", base[0], mapper)
+                if eq_t is not None:
+                    # Reweight on the replaced maps (`/root/reference/main.py:258-263`).
+                    new = new * eq_t[:, None, None, :]
+                a = cross_alpha[step]
+                edits = new * a + (1.0 - a) * edits
+            elif (attn.shape[2] <= SELF_MAX_PIXELS
+                  and self_lo <= step < self_hi):
+                edits = base.expand_as(edits)
+            return torch.cat([attn[:b], base, edits], dim=0)
+        return hook
+
+    # Text encode through transformers.CLIPTextModel on exported weights.
+    hf_cfg = transformers.CLIPTextConfig(
+        vocab_size=cfg.text.vocab_size, hidden_size=cfg.text.hidden_dim,
+        intermediate_size=cfg.text.hidden_dim * cfg.text.ff_mult,
+        num_hidden_layers=cfg.text.num_layers,
+        num_attention_heads=cfg.text.num_heads,
+        max_position_embeddings=cfg.text.max_length, hidden_act="quick_gelu")
+    text_model = transformers.CLIPTextModel(hf_cfg).eval()
+    sd = {k: _to_t(v) for k, v in
+          export_state_dict(pipe.text_params,
+                            text_encoder_entries(cfg.text)).items()}
+    text_model.load_state_dict(sd, strict=False)
+    pad = getattr(tok, "pad_token_id", tok.eos_token_id)
+    ids = np.asarray([pad_ids(tok.encode(p), L, pad)
+                      for p in list(prompts) + [""] * len(prompts)],
+                     dtype=np.int64)
+    with torch.no_grad():
+        enc = text_model(torch.from_numpy(ids)).last_hidden_state
+    ctx = torch.cat([enc[len(prompts):], enc[:len(prompts)]], dim=0)  # [uncond; cond]
+
+    # DDIM constants, computed independently in torch (closed forms of
+    # `/root/reference/null_text.py:471-480`, set_alpha_to_one=False).
+    sc = cfg.scheduler
+    betas = torch.linspace(sc.beta_start ** 0.5, sc.beta_end ** 0.5,
+                           sc.num_train_timesteps,
+                           dtype=torch.float64) ** 2
+    acp = torch.cumprod(1.0 - betas, dim=0).float()
+    step_size = sc.num_train_timesteps // NUM_STEPS
+    schedule = sched_mod.schedule_from_config(NUM_STEPS, sc, kind="ddim")
+    timesteps = [int(t) for t in np.asarray(schedule.timesteps)]
+
+    latents = _to_t(np.asarray(x_t)).permute(0, 3, 1, 2).expand(
+        len(prompts), -1, -1, -1)
+    with torch.no_grad():
+        for step, t in enumerate(timesteps):
+            latent_in = torch.cat([latents] * 2, dim=0)
+            eps = _torch_unet(pipe.unet_params, cfg.unet, latent_in, t, ctx,
+                              make_hook(step))
+            eps_uncond, eps_text = eps.chunk(2, dim=0)
+            eps = eps_uncond + GUIDANCE * (eps_text - eps_uncond)
+            prev_t = t - step_size
+            a_t = acp[t]
+            a_prev = acp[prev_t] if prev_t >= 0 else acp[0]
+            x0 = (latents - (1 - a_t).sqrt() * eps) / a_t.sqrt()
+            latents = a_prev.sqrt() * x0 + (1 - a_prev).sqrt() * eps
+        image = _torch_vae_decode(pipe.vae_params, cfg.vae, latents)
+    want_img = (image.permute(0, 2, 3, 1) / 2 + 0.5).clamp(0, 1).numpy()
+    want_img = (want_img * 255).astype(np.uint8)
+
+    # Same trajectory end to end: uint8 output within one quantization level.
+    diff = np.abs(got_img.astype(np.int32) - want_img.astype(np.int32))
+    assert diff.max() <= 1, (
+        f"max pixel diff {diff.max()}, mean {diff.mean():.4f}")
+    assert diff.mean() < 0.05
