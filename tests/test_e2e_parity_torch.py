@@ -43,6 +43,7 @@ from p2p_tpu.utils.tokenizer import HashWordTokenizer, pad_ids
 
 from test_parity_torch import (
     _to_t,
+    _torch_attention,
     _torch_conv,
     _torch_groupnorm,
     _torch_layernorm,
@@ -80,25 +81,27 @@ def _reference_modules():
     return ref_ptp, ref_aligner
 
 
-def _torch_attention(p, x, context, heads, hook=None, is_cross=None):
-    """diffusers CrossAttention forward with the reference's probability hook
-    (`/root/reference/ptp_utils.py:183-208`): softmax(QKᵀ·s) routed through
-    the controller before the V product."""
-    q = _torch_linear(p["to_q"])(x)
-    k = _torch_linear(p["to_k"])(context)
-    v = _torch_linear(p["to_v"])(context)
-    b, s_q, d = q.shape
-    dh = d // heads
+def _torch_vae_resnet(p, h, g):
+    """VAE resnet oracle (no time embedding), shared by encode/decode."""
+    r = _torch_conv(p["conv1"])(torch.nn.functional.silu(
+        _torch_groupnorm(p["norm1"], g)(h)))
+    r = _torch_conv(p["conv2"])(torch.nn.functional.silu(
+        _torch_groupnorm(p["norm2"], g)(r)))
+    skip = _torch_conv(p["skip"], padding=0)(h) if "skip" in p else h
+    return skip + r
 
-    def split(t):
-        return t.reshape(b, -1, heads, dh).permute(0, 2, 1, 3)
 
-    q, k, v = split(q), split(k), split(v)
-    attn = torch.softmax(q @ k.transpose(-1, -2) * dh ** -0.5, dim=-1)
-    if hook is not None:
-        attn = hook(attn, is_cross)
-    out = (attn @ v).permute(0, 2, 1, 3).reshape(b, s_q, d)
-    return _torch_linear(p["to_out"])(out)
+def _torch_vae_mid_attn(p, h, g):
+    """VAE mid-block single-head full self-attention oracle."""
+    bb, cc, hh, ww = h.shape
+    y = _torch_groupnorm(p["norm"], g)(h)
+    y = y.permute(0, 2, 3, 1).reshape(bb, hh * ww, cc)
+    q = _torch_linear(p["q"])(y)
+    k = _torch_linear(p["k"])(y)
+    v = _torch_linear(p["v"])(y)
+    attn = torch.softmax(q @ k.transpose(-1, -2) * cc ** -0.5, dim=-1)
+    out = _torch_linear(p["out"])(attn @ v)
+    return h + out.reshape(bb, hh, ww, cc).permute(0, 3, 1, 2)
 
 
 def _torch_unet(params, cfg, xt, t_val, ct, hook):
@@ -188,41 +191,79 @@ def _torch_vae_decode(params, cfg, z):
     """Decoder half of the VAE composition oracle
     (tests/test_parity_torch.py::test_full_vae_matches_torch_oracle)."""
     g = cfg.groups
-
-    def resnet(p, h):
-        r = _torch_conv(p["conv1"])(torch.nn.functional.silu(
-            _torch_groupnorm(p["norm1"], g)(h)))
-        r = _torch_conv(p["conv2"])(torch.nn.functional.silu(
-            _torch_groupnorm(p["norm2"], g)(r)))
-        skip = _torch_conv(p["skip"], padding=0)(h) if "skip" in p else h
-        return skip + r
-
-    def mid_attn(p, h):
-        bb, cc, hh, ww = h.shape
-        y = _torch_groupnorm(p["norm"], g)(h)
-        y = y.permute(0, 2, 3, 1).reshape(bb, hh * ww, cc)
-        q = _torch_linear(p["q"])(y)
-        k = _torch_linear(p["k"])(y)
-        v = _torch_linear(p["v"])(y)
-        attn = torch.softmax(q @ k.transpose(-1, -2) * cc ** -0.5, dim=-1)
-        out = _torch_linear(p["out"])(attn @ v)
-        return h + out.reshape(bb, hh, ww, cc).permute(0, 3, 1, 2)
-
     dec = params["decoder"]
     h = _torch_conv(dec["post_quant_conv"], padding=0)(z / cfg.scaling_factor)
     h = _torch_conv(dec["conv_in"])(h)
-    h = resnet(dec["mid"]["resnet1"], h)
-    h = mid_attn(dec["mid"]["attn"], h)
-    h = resnet(dec["mid"]["resnet2"], h)
+    h = _torch_vae_resnet(dec["mid"]["resnet1"], h, g)
+    h = _torch_vae_mid_attn(dec["mid"]["attn"], h, g)
+    h = _torch_vae_resnet(dec["mid"]["resnet2"], h, g)
     for block in dec["up"]:
         for rp in block["resnets"]:
-            h = resnet(rp, h)
+            h = _torch_vae_resnet(rp, h, g)
         if "upsample" in block:
             h = torch.nn.functional.interpolate(h, scale_factor=2,
                                                 mode="nearest")
             h = _torch_conv(block["upsample"])(h)
     h = torch.nn.functional.silu(_torch_groupnorm(dec["norm_out"], g)(h))
     return _torch_conv(dec["conv_out"])(h)
+
+
+def _torch_vae_encode(params, cfg, image):
+    """Encoder half of the VAE composition oracle: posterior mean × scale
+    (`/root/reference/null_text.py:519-531` uses ``latent_dist.mean``)."""
+    g = cfg.groups
+    enc = params["encoder"]
+    h = _torch_conv(enc["conv_in"])(image)
+    for block in enc["down"]:
+        for rp in block["resnets"]:
+            h = _torch_vae_resnet(rp, h, g)
+        if "downsample" in block:
+            h = torch.nn.functional.pad(h, (0, 1, 0, 1))
+            h = _torch_conv(block["downsample"], stride=2, padding=0)(h)
+    h = _torch_vae_resnet(enc["mid"]["resnet1"], h, g)
+    h = _torch_vae_mid_attn(enc["mid"]["attn"], h, g)
+    h = _torch_vae_resnet(enc["mid"]["resnet2"], h, g)
+    h = _torch_conv(enc["conv_out"])(torch.nn.functional.silu(
+        _torch_groupnorm(enc["norm_out"], g)(h)))
+    moments = _torch_conv(enc["quant_conv"], padding=0)(h)
+    return moments[:, :cfg.latent_channels] * cfg.scaling_factor
+
+
+def _torch_text_encode(cfg, text_params, tok, prompts):
+    """CLIP text tower on exported weights (guarded load), returning
+    last_hidden_state rows for ``prompts``."""
+    hf_cfg = transformers.CLIPTextConfig(
+        vocab_size=cfg.text.vocab_size, hidden_size=cfg.text.hidden_dim,
+        intermediate_size=cfg.text.hidden_dim * cfg.text.ff_mult,
+        num_hidden_layers=cfg.text.num_layers,
+        num_attention_heads=cfg.text.num_heads,
+        max_position_embeddings=cfg.text.max_length, hidden_act="quick_gelu")
+    text_model = transformers.CLIPTextModel(hf_cfg).eval()
+    sd = {k: _to_t(v) for k, v in
+          export_state_dict(text_params,
+                            text_encoder_entries(cfg.text)).items()}
+    missing, unexpected = text_model.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    assert all("position_ids" in m for m in missing), missing
+    L = cfg.unet.context_len
+    pad = getattr(tok, "pad_token_id", tok.eos_token_id)
+    ids = np.asarray([pad_ids(tok.encode(p), L, pad) for p in prompts],
+                     dtype=np.int64)
+    with torch.no_grad():
+        return text_model(torch.from_numpy(ids)).last_hidden_state
+
+
+def _ddim_constants(sc, num_steps):
+    """(alphas_cumprod, grid step size, descending sampling timesteps) —
+    betas/ᾱ computed independently in torch from the scheduler config."""
+    betas = torch.linspace(sc.beta_start ** 0.5, sc.beta_end ** 0.5,
+                           sc.num_train_timesteps,
+                           dtype=torch.float64) ** 2
+    acp = torch.cumprod(1.0 - betas, dim=0).float()
+    step_size = sc.num_train_timesteps // num_steps
+    schedule = sched_mod.schedule_from_config(num_steps, sc, kind="ddim")
+    timesteps = [int(t) for t in np.asarray(schedule.timesteps)]
+    return acp, step_size, timesteps
 
 
 @pytest.mark.parametrize("mode", list(PROMPTS_BY_MODE))
@@ -310,35 +351,13 @@ def test_text2image_matches_torch_pipeline(mode):
         return hook
 
     # Text encode through transformers.CLIPTextModel on exported weights.
-    hf_cfg = transformers.CLIPTextConfig(
-        vocab_size=cfg.text.vocab_size, hidden_size=cfg.text.hidden_dim,
-        intermediate_size=cfg.text.hidden_dim * cfg.text.ff_mult,
-        num_hidden_layers=cfg.text.num_layers,
-        num_attention_heads=cfg.text.num_heads,
-        max_position_embeddings=cfg.text.max_length, hidden_act="quick_gelu")
-    text_model = transformers.CLIPTextModel(hf_cfg).eval()
-    sd = {k: _to_t(v) for k, v in
-          export_state_dict(pipe.text_params,
-                            text_encoder_entries(cfg.text)).items()}
-    text_model.load_state_dict(sd, strict=False)
-    pad = getattr(tok, "pad_token_id", tok.eos_token_id)
-    ids = np.asarray([pad_ids(tok.encode(p), L, pad)
-                      for p in list(prompts) + [""] * len(prompts)],
-                     dtype=np.int64)
-    with torch.no_grad():
-        enc = text_model(torch.from_numpy(ids)).last_hidden_state
+    enc = _torch_text_encode(cfg, pipe.text_params, tok,
+                             list(prompts) + [""] * len(prompts))
     ctx = torch.cat([enc[len(prompts):], enc[:len(prompts)]], dim=0)  # [uncond; cond]
 
     # DDIM constants, computed independently in torch (closed forms of
     # `/root/reference/null_text.py:471-480`, set_alpha_to_one=False).
-    sc = cfg.scheduler
-    betas = torch.linspace(sc.beta_start ** 0.5, sc.beta_end ** 0.5,
-                           sc.num_train_timesteps,
-                           dtype=torch.float64) ** 2
-    acp = torch.cumprod(1.0 - betas, dim=0).float()
-    step_size = sc.num_train_timesteps // NUM_STEPS
-    schedule = sched_mod.schedule_from_config(NUM_STEPS, sc, kind="ddim")
-    timesteps = [int(t) for t in np.asarray(schedule.timesteps)]
+    acp, step_size, timesteps = _ddim_constants(cfg.scheduler, NUM_STEPS)
 
     latents = _to_t(np.asarray(x_t)).permute(0, 3, 1, 2).expand(
         len(prompts), -1, -1, -1)
@@ -363,3 +382,105 @@ def test_text2image_matches_torch_pipeline(mode):
     assert diff.max() <= 1, (
         f"max pixel diff {diff.max()}, mean {diff.mean():.4f}")
     assert diff.mean() < 0.05
+
+
+def test_null_text_inversion_matches_torch_pipeline():
+    """Null-text inversion e2e vs a hand-rolled torch loop: VAE-encode →
+    T-step DDIM ascent at guidance 1 (`/root/reference/null_text.py:551-561`)
+    → per-timestep Adam optimization of the uncond embedding
+    (`/root/reference/null_text.py:574-606`). Early stop is disabled on both
+    sides (epsilon = -inf ⇒ every inner step runs) so trajectories can be
+    compared deterministically. The lr decay follows our i/(2T)
+    generalization of the reference's literal 1e-2·(1−i/100) (identical at
+    T=50; `p2p_tpu/engine/inversion.py:147-151`)."""
+    from p2p_tpu.engine.inversion import invert
+
+    cfg = TINY
+    tok = HashWordTokenizer(model_max_length=cfg.text.max_length)
+    prompt = "a cat riding a bike"
+    num_steps = 2
+    num_inner = 2
+    pipe = Pipeline(
+        config=cfg,
+        unet_params=init_unet(jax.random.PRNGKey(0), cfg.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(1), cfg.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae),
+        tokenizer=tok,
+    )
+    rng = np.random.RandomState(3)
+    image = rng.uniform(-0.8, 0.8,
+                        (1, cfg.image_size, cfg.image_size, 3)).astype(np.float32)
+
+    # --- ours ---------------------------------------------------------------
+    art = invert(pipe, image, prompt, num_steps=num_steps,
+                 guidance_scale=GUIDANCE, num_inner_steps=num_inner,
+                 early_stop_epsilon=-1e30)
+
+    # --- torch --------------------------------------------------------------
+    enc = _torch_text_encode(cfg, pipe.text_params, tok, (prompt, ""))
+    cond, uncond0 = enc[:1], enc[1:]
+
+    acp, step_size, timesteps = _ddim_constants(cfg.scheduler, num_steps)
+
+    def alpha_at(t):
+        return acp[t] if t >= 0 else acp[0]
+
+    def ddim_prev(eps, t, x):
+        a_t, a_prev = alpha_at(t), alpha_at(t - step_size)
+        x0 = (x - (1 - a_t).sqrt() * eps) / a_t.sqrt()
+        return a_prev.sqrt() * x0 + (1 - a_prev).sqrt() * eps
+
+    def ddim_next(eps, t, x):
+        # `/root/reference/null_text.py:481-489`: current point is one grid
+        # step below t, target point is t.
+        a_cur, a_next = alpha_at(t - step_size), alpha_at(t)
+        x0 = (x - (1 - a_cur).sqrt() * eps) / a_cur.sqrt()
+        return a_next.sqrt() * x0 + (1 - a_next).sqrt() * eps
+
+    with torch.no_grad():
+        latent = _torch_vae_encode(pipe.vae_params, cfg.vae,
+                                   _to_t(image).permute(0, 3, 1, 2))
+        all_latents = [latent]
+        for i in range(num_steps):
+            t = timesteps[num_steps - 1 - i]  # ascending
+            eps = _torch_unet(pipe.unet_params, cfg.unet, latent, t, cond, None)
+            latent = ddim_next(eps, t, latent)
+            all_latents.append(latent)
+
+    # Inverted terminal latent parity.
+    np.testing.assert_allclose(
+        np.asarray(art.x_t), all_latents[-1].permute(0, 2, 3, 1).numpy(),
+        atol=2e-4, rtol=1e-3)
+
+    # Null-text optimization parity (torch.optim.Adam vs our closed form).
+    t_count = num_steps
+    latent_cur = all_latents[-1]
+    uncond = uncond0.clone()
+    want_unconds = []
+    for i, t in enumerate(timesteps):
+        lr = 0.01 * (1.0 - i / (2.0 * t_count))
+        with torch.no_grad():
+            eps_cond = _torch_unet(pipe.unet_params, cfg.unet, latent_cur, t,
+                                   cond, None)
+        u = uncond.clone().requires_grad_(True)
+        opt = torch.optim.Adam([u], lr=lr)
+        target = all_latents[t_count - 1 - i]
+        for _ in range(num_inner):
+            eps_u = _torch_unet(pipe.unet_params, cfg.unet, latent_cur, t, u,
+                                None)
+            eps = eps_u + GUIDANCE * (eps_cond - eps_u)
+            loss = torch.nn.functional.mse_loss(ddim_prev(eps, t, latent_cur),
+                                                target)
+            opt.zero_grad()
+            loss.backward()
+            opt.step()
+        uncond = u.detach()
+        want_unconds.append(uncond.numpy())
+        with torch.no_grad():
+            eps_u = _torch_unet(pipe.unet_params, cfg.unet, latent_cur, t,
+                                uncond, None)
+            eps = eps_u + GUIDANCE * (eps_cond - eps_u)
+            latent_cur = ddim_prev(eps, t, latent_cur)
+
+    np.testing.assert_allclose(
+        art.uncond_embeddings, np.stack(want_unconds), atol=5e-4, rtol=1e-2)

@@ -112,9 +112,12 @@ def _torch_layernorm(p, eps=1e-5):
     return ln
 
 
-def _torch_attention(p, x, context, heads):
+def _torch_attention(p, x, context, heads, hook=None, is_cross=None):
     """diffusers CrossAttention forward (`/root/reference/ptp_utils.py:183-208`
-    is the monkey-patched spec): q/k/v projections, head split, softmax(QKᵀ·s)."""
+    is the monkey-patched spec): q/k/v projections, head split, softmax(QKᵀ·s).
+    ``hook(attn, is_cross)`` is the reference's controller detour, applied to
+    the probability tensor before the V product (used by the e2e parity
+    tests; None leaves the plain forward)."""
     q = _torch_linear(p["to_q"])(x)
     k = _torch_linear(p["to_k"])(context)
     v = _torch_linear(p["to_v"])(context)
@@ -126,6 +129,8 @@ def _torch_attention(p, x, context, heads):
 
     q, k, v = split(q), split(k), split(v)
     attn = torch.softmax(q @ k.transpose(-1, -2) * dh ** -0.5, dim=-1)
+    if hook is not None:
+        attn = hook(attn, is_cross)
     out = (attn @ v).permute(0, 2, 1, 3).reshape(b, s_q, d)
     return _torch_linear(p["to_out"])(out)
 
