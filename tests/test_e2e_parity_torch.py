@@ -59,11 +59,15 @@ SELF_REPLACE = 0.5
 SELF_MAX_PIXELS = 16 * 16
 
 # One prompt pair per edit kind: same word count for Replace/Reweight, a word
-# insertion for Refine (NW-aligned gather path).
+# insertion for Refine (NW-aligned gather path). "replace_vpred" reruns the
+# Replace edit on a v-prediction backend (the SD-2.1 768-v convention the
+# reference marks "Not work", `/root/reference/main.py:27`) — the torch loop
+# then converts v → ε with the independent closed form ε = √ᾱ·v + √(1−ᾱ)·x.
 PROMPTS_BY_MODE = {
     "replace": ["a cat riding a bike", "a dog riding a bike"],
     "refine": ["a cat riding a bike", "a fluffy cat riding a bike"],
     "reweight_on_replace": ["a cat riding a bike", "a dog riding a bike"],
+    "replace_vpred": ["a cat riding a bike", "a dog riding a bike"],
 }
 
 
@@ -272,6 +276,12 @@ def test_text2image_matches_torch_pipeline(mode):
     tok = HashWordTokenizer(model_max_length=cfg.text.max_length)
     L = cfg.unet.context_len
     prompts = PROMPTS_BY_MODE[mode]
+    if mode == "replace_vpred":
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg, scheduler=dataclasses.replace(
+                cfg.scheduler, prediction_type="v_prediction"))
     pipe = Pipeline(
         config=cfg,
         unet_params=init_unet(jax.random.PRNGKey(0), cfg.unet),
@@ -296,7 +306,7 @@ def test_text2image_matches_torch_pipeline(mode):
     kwargs = dict(cross_replace_steps=CROSS_REPLACE,
                   self_replace_steps=SELF_REPLACE, tokenizer=tok,
                   self_max_pixels=SELF_MAX_PIXELS, max_len=L)
-    if mode == "replace":
+    if mode in ("replace", "replace_vpred"):
         controller = factory.attention_replace(prompts, NUM_STEPS, **kwargs)
     elif mode == "refine":
         controller = factory.attention_refine(prompts, NUM_STEPS, **kwargs)
@@ -370,6 +380,10 @@ def test_text2image_matches_torch_pipeline(mode):
             eps = eps_uncond + GUIDANCE * (eps_text - eps_uncond)
             prev_t = t - step_size
             a_t = acp[t]
+            if mode == "replace_vpred":
+                # The model output is v; convert once after the (linear) CFG
+                # combine: ε = √ᾱ_t·v + √(1−ᾱ_t)·x_t.
+                eps = a_t.sqrt() * eps + (1 - a_t).sqrt() * latents
             a_prev = acp[prev_t] if prev_t >= 0 else acp[0]
             x0 = (latents - (1 - a_t).sqrt() * eps) / a_t.sqrt()
             latents = a_prev.sqrt() * x0 + (1 - a_prev).sqrt() * eps
