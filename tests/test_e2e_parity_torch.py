@@ -193,10 +193,16 @@ def _torch_unet(params, cfg, xt, t_val, ct, hook):
 
 def _torch_vae_decode(params, cfg, z):
     """Decoder half of the VAE composition oracle
-    (tests/test_parity_torch.py::test_full_vae_matches_torch_oracle)."""
+    (tests/test_parity_torch.py::test_full_vae_matches_torch_oracle).
+    Mirrors `vae.decode`'s structure: unscale, VQ codebook snap when
+    ``cfg.kind == 'vq'`` (`/root/reference/ptp_utils.py:124` routes the LDM
+    VQ decode through the same `latent2image`), then the decoder trunk."""
     g = cfg.groups
     dec = params["decoder"]
-    h = _torch_conv(dec["post_quant_conv"], padding=0)(z / cfg.scaling_factor)
+    h = z / cfg.scaling_factor
+    if cfg.kind == "vq":
+        h = _torch_vq_quantize(params, h)
+    h = _torch_conv(dec["post_quant_conv"], padding=0)(h)
     h = _torch_conv(dec["conv_in"])(h)
     h = _torch_vae_resnet(dec["mid"]["resnet1"], h, g)
     h = _torch_vae_mid_attn(dec["mid"]["attn"], h, g)
@@ -270,6 +276,68 @@ def _ddim_constants(sc, num_steps):
     return acp, step_size, timesteps
 
 
+def _make_edit_hook(kind, mapper, cross_alpha, refine_alphas=None, eq_t=None,
+                    self_window=(0, 0), self_max_pixels=SELF_MAX_PIXELS):
+    """step → attention hook applying the reference's controller math
+    (`/root/reference/main.py:85-98,162-263`), shared by every e2e loop."""
+    self_lo, self_hi = self_window
+
+    def make_hook(step):
+        def hook(attn, is_cross):
+            # Cond-half-only edits (`/root/reference/main.py:90-92`): the CFG
+            # batch is [uncond(B); cond(B)], prompt 0 is the source.
+            b = attn.shape[0] // 2
+            cond = attn[b:]
+            base, edits = cond[:1], cond[1:]
+            if is_cross:
+                if kind == "refine":
+                    # Gather + existed-token blend (`/root/reference/main.py:235-239`).
+                    new = base[0][:, :, mapper].permute(2, 0, 1, 3)
+                    new = new * refine_alphas + edits * (1.0 - refine_alphas)
+                else:
+                    new = torch.einsum("hpw,bwn->bhpn", base[0], mapper)
+                if eq_t is not None:
+                    # Reweight on the replaced maps (`/root/reference/main.py:258-263`).
+                    new = new * eq_t[:, None, None, :]
+                a = cross_alpha[step]
+                edits = new * a + (1.0 - a) * edits
+            elif (attn.shape[2] <= self_max_pixels
+                  and self_lo <= step < self_hi):
+                edits = base.expand_as(edits)
+            return torch.cat([attn[:b], base, edits], dim=0)
+        return hook
+    return make_hook
+
+
+def _torch_cfg_sample(pipe, cfg, ctx, x_t, n_prompts, make_hook, guidance,
+                      num_steps, vpred=False):
+    """The reference sampling loop (`/root/reference/ptp_utils.py:65-76,
+    129-172`) in torch: CFG batch-doubling, hooked U-Net, DDIM update, VAE
+    decode, uint8 — returns the (B, H, W, 3) uint8 images."""
+    acp, step_size, timesteps = _ddim_constants(cfg.scheduler, num_steps)
+    latents = _to_t(np.asarray(x_t)).permute(0, 3, 1, 2).expand(
+        n_prompts, -1, -1, -1)
+    with torch.no_grad():
+        for step, t in enumerate(timesteps):
+            latent_in = torch.cat([latents] * 2, dim=0)
+            eps = _torch_unet(pipe.unet_params, cfg.unet, latent_in, t, ctx,
+                              make_hook(step))
+            eps_uncond, eps_text = eps.chunk(2, dim=0)
+            eps = eps_uncond + guidance * (eps_text - eps_uncond)
+            prev_t = t - step_size
+            a_t = acp[t]
+            if vpred:
+                # The model output is v; convert once after the (linear) CFG
+                # combine: ε = √ᾱ_t·v + √(1−ᾱ_t)·x_t.
+                eps = a_t.sqrt() * eps + (1 - a_t).sqrt() * latents
+            a_prev = acp[prev_t] if prev_t >= 0 else acp[0]
+            x0 = (latents - (1 - a_t).sqrt() * eps) / a_t.sqrt()
+            latents = a_prev.sqrt() * x0 + (1 - a_prev).sqrt() * eps
+        image = _torch_vae_decode(pipe.vae_params, cfg.vae, latents)
+    img = (image.permute(0, 2, 3, 1) / 2 + 0.5).clamp(0, 1).numpy()
+    return (img * 255).astype(np.uint8)
+
+
 @pytest.mark.parametrize("mode", list(PROMPTS_BY_MODE))
 def test_text2image_matches_torch_pipeline(mode):
     cfg = TINY
@@ -333,63 +401,19 @@ def test_text2image_matches_torch_pipeline(mode):
         mapper = ref_aligner.get_replacement_mapper(
             prompts, tok, max_len=L).float()
     eq_t = None if equalizer is None else torch.from_numpy(equalizer)
-    self_lo, self_hi = 0, int(NUM_STEPS * SELF_REPLACE)
-
-    def make_hook(step):
-        def hook(attn, is_cross):
-            # Cond-half-only edits (`/root/reference/main.py:90-92`): the CFG
-            # batch is [uncond(B); cond(B)], prompt 0 is the source.
-            b = attn.shape[0] // 2
-            cond = attn[b:]
-            base, edits = cond[:1], cond[1:]
-            if is_cross:
-                if mode == "refine":
-                    # Gather + existed-token blend (`/root/reference/main.py:235-239`).
-                    new = base[0][:, :, mapper].permute(2, 0, 1, 3)
-                    new = new * refine_alphas + edits * (1.0 - refine_alphas)
-                else:
-                    new = torch.einsum("hpw,bwn->bhpn", base[0], mapper)
-                if eq_t is not None:
-                    # Reweight on the replaced maps (`/root/reference/main.py:258-263`).
-                    new = new * eq_t[:, None, None, :]
-                a = cross_alpha[step]
-                edits = new * a + (1.0 - a) * edits
-            elif (attn.shape[2] <= SELF_MAX_PIXELS
-                  and self_lo <= step < self_hi):
-                edits = base.expand_as(edits)
-            return torch.cat([attn[:b], base, edits], dim=0)
-        return hook
+    make_hook = _make_edit_hook(
+        "refine" if mode == "refine" else "replace", mapper, cross_alpha,
+        refine_alphas=refine_alphas if mode == "refine" else None, eq_t=eq_t,
+        self_window=(0, int(NUM_STEPS * SELF_REPLACE)))
 
     # Text encode through transformers.CLIPTextModel on exported weights.
     enc = _torch_text_encode(cfg, pipe.text_params, tok,
                              list(prompts) + [""] * len(prompts))
     ctx = torch.cat([enc[len(prompts):], enc[:len(prompts)]], dim=0)  # [uncond; cond]
 
-    # DDIM constants, computed independently in torch (closed forms of
-    # `/root/reference/null_text.py:471-480`, set_alpha_to_one=False).
-    acp, step_size, timesteps = _ddim_constants(cfg.scheduler, NUM_STEPS)
-
-    latents = _to_t(np.asarray(x_t)).permute(0, 3, 1, 2).expand(
-        len(prompts), -1, -1, -1)
-    with torch.no_grad():
-        for step, t in enumerate(timesteps):
-            latent_in = torch.cat([latents] * 2, dim=0)
-            eps = _torch_unet(pipe.unet_params, cfg.unet, latent_in, t, ctx,
-                              make_hook(step))
-            eps_uncond, eps_text = eps.chunk(2, dim=0)
-            eps = eps_uncond + GUIDANCE * (eps_text - eps_uncond)
-            prev_t = t - step_size
-            a_t = acp[t]
-            if mode == "replace_vpred":
-                # The model output is v; convert once after the (linear) CFG
-                # combine: ε = √ᾱ_t·v + √(1−ᾱ_t)·x_t.
-                eps = a_t.sqrt() * eps + (1 - a_t).sqrt() * latents
-            a_prev = acp[prev_t] if prev_t >= 0 else acp[0]
-            x0 = (latents - (1 - a_t).sqrt() * eps) / a_t.sqrt()
-            latents = a_prev.sqrt() * x0 + (1 - a_prev).sqrt() * eps
-        image = _torch_vae_decode(pipe.vae_params, cfg.vae, latents)
-    want_img = (image.permute(0, 2, 3, 1) / 2 + 0.5).clamp(0, 1).numpy()
-    want_img = (want_img * 255).astype(np.uint8)
+    want_img = _torch_cfg_sample(pipe, cfg, ctx, x_t, len(prompts), make_hook,
+                                 GUIDANCE, NUM_STEPS,
+                                 vpred=(mode == "replace_vpred"))
 
     # Same trajectory end to end: uint8 output within one quantization level.
     diff = np.abs(got_img.astype(np.int32) - want_img.astype(np.int32))
@@ -498,3 +522,106 @@ def test_null_text_inversion_matches_torch_pipeline():
 
     np.testing.assert_allclose(
         art.uncond_embeddings, np.stack(want_unconds), atol=5e-4, rtol=1e-2)
+
+
+def _torch_text_oracle(params, cfg, ids):
+    """Generic transformer text-encoder oracle over our param pytree —
+    covers the LDMBert-style tower (non-causal, gelu, no qkv bias,
+    rectangular attention) that has no transformers counterpart
+    (`p2p_tpu/models/text_encoder.py` spec)."""
+    b, length = ids.shape
+    x = _to_t(params["token_embed"])[torch.from_numpy(ids)]
+    x = x + _to_t(params["pos_embed"])[:length]
+    heads = cfg.num_heads
+    d_head = cfg.inner_dim // heads
+
+    def split(t):
+        return t.reshape(b, length, heads, d_head).permute(0, 2, 1, 3)
+
+    for layer in params["layers"]:
+        h = _torch_layernorm(layer["ln1"])(x)
+        q = split(_torch_linear(layer["q"])(h))
+        k = split(_torch_linear(layer["k"])(h))
+        v = split(_torch_linear(layer["v"])(h))
+        sim = q @ k.transpose(-1, -2) * d_head ** -0.5
+        if cfg.causal:
+            sim = sim + torch.triu(
+                torch.full((length, length), -1e9), diagonal=1)
+        attn = torch.softmax(sim, dim=-1)
+        out = (attn @ v).permute(0, 2, 1, 3).reshape(b, length, cfg.inner_dim)
+        x = x + _torch_linear(layer["out"])(out)
+        h = _torch_layernorm(layer["ln2"])(x)
+        act = ((lambda t: t * torch.sigmoid(1.702 * t))
+               if cfg.activation == "quick_gelu"
+               else torch.nn.functional.gelu)
+        x = x + _torch_linear(layer["fc2"])(act(_torch_linear(layer["fc1"])(h)))
+    return _torch_layernorm(params["final_ln"])(x)
+
+
+def _torch_vq_quantize(params, z):
+    """Nearest-codebook snap (`p2p_tpu/models/vae.py:quantize` spec — the
+    lookup diffusers' VQModel.decode performs)."""
+    cb = _to_t(params["codebook"])                      # (K, C)
+    b, c, h, w = z.shape
+    flat = z.permute(0, 2, 3, 1).reshape(-1, c)         # (P, C)
+    idx = torch.cdist(flat, cb).argmin(dim=1)
+    return cb[idx].reshape(b, h, w, c).permute(0, 3, 1, 2)
+
+
+def test_ldm_text2image_matches_torch_pipeline():
+    """BASELINE config 5's backend family e2e: LDMBert-style encoder,
+    per-level-heads U-Net, LDM β schedule, VQ codebook decode
+    (`/root/reference/ptp_utils.py:98-126`), under an AttentionReplace
+    controller — vs the hand-rolled torch loop."""
+    from p2p_tpu.models import TINY_LDM
+
+    cfg = TINY_LDM
+    tok = HashWordTokenizer(vocab_size=cfg.text.vocab_size,
+                            model_max_length=cfg.text.max_length)
+    L = cfg.unet.context_len
+    prompts = PROMPTS_BY_MODE["replace"]
+    pipe = Pipeline(
+        config=cfg,
+        unet_params=init_unet(jax.random.PRNGKey(0), cfg.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(1), cfg.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae),
+        tokenizer=tok,
+    )
+    x_t = jax.random.normal(jax.random.PRNGKey(5),
+                            (1,) + pipe.latent_shape, jnp.float32)
+
+    controller = factory.attention_replace(
+        prompts, NUM_STEPS, cross_replace_steps=CROSS_REPLACE,
+        self_replace_steps=SELF_REPLACE, tokenizer=tok,
+        self_max_pixels=SELF_MAX_PIXELS, max_len=L)
+    got_img, _, _ = text2image(pipe, prompts, controller, num_steps=NUM_STEPS,
+                               scheduler="ddim", latent=x_t)
+    got_img = np.asarray(got_img)
+
+    ref_ptp, ref_aligner = _reference_modules()
+    mapper = ref_aligner.get_replacement_mapper(prompts, tok, max_len=L).float()
+    cross_alpha = ref_ptp.get_time_words_attention_alpha(
+        prompts, NUM_STEPS, CROSS_REPLACE, tok, max_num_words=L).float()
+    make_hook = _make_edit_hook(
+        "replace", mapper, cross_alpha,
+        self_window=(0, int(NUM_STEPS * SELF_REPLACE)))
+
+    # LDMBert-style tower has no transformers counterpart — encode through
+    # the generic transformer oracle.
+    pad = getattr(tok, "pad_token_id", tok.eos_token_id)
+    ids = np.asarray([pad_ids(tok.encode(p), L, pad)
+                      for p in list(prompts) + [""] * len(prompts)],
+                     dtype=np.int64)
+    with torch.no_grad():
+        enc = _torch_text_oracle(pipe.text_params, cfg.text, ids)
+    ctx = torch.cat([enc[len(prompts):], enc[:len(prompts)]], dim=0)
+
+    # guidance falls back to cfg.guidance_scale (LDM default 5.0) on the jax
+    # side; the VQ codebook snap happens inside _torch_vae_decode.
+    want_img = _torch_cfg_sample(pipe, cfg, ctx, x_t, len(prompts), make_hook,
+                                 cfg.guidance_scale, NUM_STEPS)
+
+    diff = np.abs(got_img.astype(np.int32) - want_img.astype(np.int32))
+    assert diff.max() <= 1, (
+        f"max pixel diff {diff.max()}, mean {diff.mean():.4f}")
+    assert diff.mean() < 0.05
