@@ -310,11 +310,17 @@ def _make_edit_hook(kind, mapper, cross_alpha, refine_alphas=None, eq_t=None,
 
 
 def _torch_cfg_sample(pipe, cfg, ctx, x_t, n_prompts, make_hook, guidance,
-                      num_steps, vpred=False):
+                      num_steps, vpred=False, timesteps=None, stepper=None):
     """The reference sampling loop (`/root/reference/ptp_utils.py:65-76,
-    129-172`) in torch: CFG batch-doubling, hooked U-Net, DDIM update, VAE
-    decode, uint8 — returns the (B, H, W, 3) uint8 images."""
-    acp, step_size, timesteps = _ddim_constants(cfg.scheduler, num_steps)
+    129-172`) in torch: CFG batch-doubling, hooked U-Net, latent update, VAE
+    decode, uint8 — returns the (B, H, W, 3) uint8 images.
+
+    ``stepper(step, t, eps, latents) -> latents`` overrides the per-step
+    latent update (default: the DDIM closed form); pass ``timesteps`` with it
+    when the scheduler walks a different grid (e.g. PLMS's T+1 warm-up)."""
+    acp, step_size, ddim_ts = _ddim_constants(cfg.scheduler, num_steps)
+    if timesteps is None:
+        timesteps = ddim_ts
     latents = _to_t(np.asarray(x_t)).permute(0, 3, 1, 2).expand(
         n_prompts, -1, -1, -1)
     with torch.no_grad():
@@ -324,15 +330,18 @@ def _torch_cfg_sample(pipe, cfg, ctx, x_t, n_prompts, make_hook, guidance,
                               make_hook(step))
             eps_uncond, eps_text = eps.chunk(2, dim=0)
             eps = eps_uncond + guidance * (eps_text - eps_uncond)
-            prev_t = t - step_size
             a_t = acp[t]
             if vpred:
                 # The model output is v; convert once after the (linear) CFG
                 # combine: ε = √ᾱ_t·v + √(1−ᾱ_t)·x_t.
                 eps = a_t.sqrt() * eps + (1 - a_t).sqrt() * latents
-            a_prev = acp[prev_t] if prev_t >= 0 else acp[0]
-            x0 = (latents - (1 - a_t).sqrt() * eps) / a_t.sqrt()
-            latents = a_prev.sqrt() * x0 + (1 - a_prev).sqrt() * eps
+            if stepper is not None:
+                latents = stepper(step, t, eps, latents)
+            else:
+                prev_t = t - step_size
+                a_prev = acp[prev_t] if prev_t >= 0 else acp[0]
+                x0 = (latents - (1 - a_t).sqrt() * eps) / a_t.sqrt()
+                latents = a_prev.sqrt() * x0 + (1 - a_prev).sqrt() * eps
         image = _torch_vae_decode(pipe.vae_params, cfg.vae, latents)
     img = (image.permute(0, 2, 3, 1) / 2 + 0.5).clamp(0, 1).numpy()
     return (img * 255).astype(np.uint8)
@@ -620,6 +629,69 @@ def test_ldm_text2image_matches_torch_pipeline():
     # side; the VQ codebook snap happens inside _torch_vae_decode.
     want_img = _torch_cfg_sample(pipe, cfg, ctx, x_t, len(prompts), make_hook,
                                  cfg.guidance_scale, NUM_STEPS)
+
+    diff = np.abs(got_img.astype(np.int32) - want_img.astype(np.int32))
+    assert diff.max() <= 1, (
+        f"max pixel diff {diff.max()}, mean {diff.mean():.4f}")
+    assert diff.mean() < 0.05
+
+
+def test_text2image_plms_matches_torch_pipeline():
+    """PLMS e2e — the scheduler the reference CLI inherits from the SD
+    pipeline (`/root/reference/main.py:29`, `steps_offset=1`): T+1 hooked
+    U-Net calls with the warm-up double evaluation, stepped on the torch side
+    by the independent list-based PLMS oracle (tests/test_schedulers.py's
+    PlmsSimulator, Liu et al. arXiv 2202.09778), under a Replace edit."""
+    from test_schedulers import PlmsSimulator
+
+    cfg = TINY
+    tok = HashWordTokenizer(model_max_length=cfg.text.max_length)
+    L = cfg.unet.context_len
+    prompts = PROMPTS_BY_MODE["replace"]
+    pipe = Pipeline(
+        config=cfg,
+        unet_params=init_unet(jax.random.PRNGKey(0), cfg.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(1), cfg.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae),
+        tokenizer=tok,
+    )
+    x_t = jax.random.normal(jax.random.PRNGKey(5),
+                            (1,) + pipe.latent_shape, jnp.float32)
+
+    controller = factory.attention_replace(
+        prompts, NUM_STEPS, cross_replace_steps=CROSS_REPLACE,
+        self_replace_steps=SELF_REPLACE, tokenizer=tok,
+        self_max_pixels=SELF_MAX_PIXELS, max_len=L)
+    got_img, _, _ = text2image(pipe, prompts, controller, num_steps=NUM_STEPS,
+                               guidance_scale=GUIDANCE, scheduler="plms",
+                               latent=x_t)
+    got_img = np.asarray(got_img)
+
+    ref_ptp, ref_aligner = _reference_modules()
+    mapper = ref_aligner.get_replacement_mapper(prompts, tok, max_len=L).float()
+    cross_alpha = ref_ptp.get_time_words_attention_alpha(
+        prompts, NUM_STEPS, CROSS_REPLACE, tok, max_num_words=L).float()
+    make_hook = _make_edit_hook(
+        "replace", mapper, cross_alpha,
+        self_window=(0, int(NUM_STEPS * SELF_REPLACE)))
+
+    enc = _torch_text_encode(cfg, pipe.text_params, tok,
+                             list(prompts) + [""] * len(prompts))
+    ctx = torch.cat([enc[len(prompts):], enc[:len(prompts)]], dim=0)
+
+    # PLMS timesteps (T+1 with the second repeated, steps_offset=1) from our
+    # schedule builder; alphas and the multistep combination come from the
+    # independent simulator, plugged into the shared loop as the stepper.
+    schedule = sched_mod.schedule_from_config(NUM_STEPS, cfg.scheduler,
+                                              kind="plms")
+    timesteps = [int(t) for t in np.asarray(schedule.timesteps)]
+    acp_np = np.asarray(schedule.alphas_cumprod, dtype=np.float64)
+    sim = PlmsSimulator(acp_np, schedule.step_size)
+
+    want_img = _torch_cfg_sample(
+        pipe, cfg, ctx, x_t, len(prompts), make_hook, GUIDANCE, NUM_STEPS,
+        timesteps=timesteps,
+        stepper=lambda step, t, eps, latents: sim(eps, int(t), latents))
 
     diff = np.abs(got_img.astype(np.int32) - want_img.astype(np.int32))
     assert diff.max() <= 1, (
