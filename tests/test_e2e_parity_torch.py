@@ -310,14 +310,18 @@ def _make_edit_hook(kind, mapper, cross_alpha, refine_alphas=None, eq_t=None,
 
 
 def _torch_cfg_sample(pipe, cfg, ctx, x_t, n_prompts, make_hook, guidance,
-                      num_steps, vpred=False, timesteps=None, stepper=None):
+                      num_steps, vpred=False, timesteps=None, stepper=None,
+                      post_step=None):
     """The reference sampling loop (`/root/reference/ptp_utils.py:65-76,
     129-172`) in torch: CFG batch-doubling, hooked U-Net, latent update, VAE
     decode, uint8 — returns the (B, H, W, 3) uint8 images.
 
     ``stepper(step, t, eps, latents) -> latents`` overrides the per-step
     latent update (default: the DDIM closed form); pass ``timesteps`` with it
-    when the scheduler walks a different grid (e.g. PLMS's T+1 warm-up)."""
+    when the scheduler walks a different grid (e.g. PLMS's T+1 warm-up).
+    ``post_step(step, latents) -> latents`` is the controller's latent hook
+    after the scheduler update (`controller.step_callback`,
+    `/root/reference/ptp_utils.py:75`) — LocalBlend lives there."""
     acp, step_size, ddim_ts = _ddim_constants(cfg.scheduler, num_steps)
     if timesteps is None:
         timesteps = ddim_ts
@@ -342,6 +346,8 @@ def _torch_cfg_sample(pipe, cfg, ctx, x_t, n_prompts, make_hook, guidance,
                 a_prev = acp[prev_t] if prev_t >= 0 else acp[0]
                 x0 = (latents - (1 - a_t).sqrt() * eps) / a_t.sqrt()
                 latents = a_prev.sqrt() * x0 + (1 - a_prev).sqrt() * eps
+            if post_step is not None:
+                latents = post_step(step, latents)
         image = _torch_vae_decode(pipe.vae_params, cfg.vae, latents)
     img = (image.permute(0, 2, 3, 1) / 2 + 0.5).clamp(0, 1).numpy()
     return (img * 255).astype(np.uint8)
@@ -692,6 +698,114 @@ def test_text2image_plms_matches_torch_pipeline():
         pipe, cfg, ctx, x_t, len(prompts), make_hook, GUIDANCE, NUM_STEPS,
         timesteps=timesteps,
         stepper=lambda step, t, eps, latents: sim(eps, int(t), latents))
+
+    diff = np.abs(got_img.astype(np.int32) - want_img.astype(np.int32))
+    assert diff.max() <= 1, (
+        f"max pixel diff {diff.max()}, mean {diff.mean():.4f}")
+    assert diff.mean() < 0.05
+
+
+def test_text2image_local_blend_matches_torch_pipeline():
+    """LocalBlend e2e: a Replace edit whose latents are composited through the
+    attention-derived spatial mask after every scheduler step
+    (`/root/reference/main.py:33-66` base math with the null_text
+    ``start_blend`` warm-up and batch-general OR,
+    `/root/reference/null_text.py:39-102`). The torch loop accumulates the
+    post-edit conditional cross maps at the blend resolution per step —
+    exactly what our fixed-shape store slots hold — and hand-rolls the mask:
+    word-weighted average → 3×3 max-pool → nearest-upsample → per-image
+    max-normalize → threshold → OR with the source mask → composite."""
+    cfg = TINY
+    tok = HashWordTokenizer(model_max_length=cfg.text.max_length)
+    L = cfg.unet.context_len
+    prompts = PROMPTS_BY_MODE["replace"]
+    blend_words = (("cat",), ("dog",))
+    blend_res = cfg.latent_size // 2        # 8: the stored mid-pyramid level
+    start_blend_frac = 0.4                  # int(0.4·3)=1 ⇒ step 0 ungated
+    pipe = Pipeline(
+        config=cfg,
+        unet_params=init_unet(jax.random.PRNGKey(0), cfg.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(1), cfg.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae),
+        tokenizer=tok,
+    )
+    x_t = jax.random.normal(jax.random.PRNGKey(5),
+                            (1,) + pipe.latent_shape, jnp.float32)
+
+    lb = factory.local_blend(prompts, blend_words, tok,
+                             start_blend=start_blend_frac,
+                             num_steps=NUM_STEPS, resolution=blend_res,
+                             max_len=L)
+    controller = factory.attention_replace(
+        prompts, NUM_STEPS, cross_replace_steps=CROSS_REPLACE,
+        self_replace_steps=SELF_REPLACE, tokenizer=tok,
+        self_max_pixels=SELF_MAX_PIXELS, max_len=L, local_blend=lb)
+    got_img, _, _ = text2image(pipe, prompts, controller, num_steps=NUM_STEPS,
+                               guidance_scale=GUIDANCE, scheduler="ddim",
+                               latent=x_t)
+    got_img = np.asarray(got_img)
+
+    ref_ptp, ref_aligner = _reference_modules()
+    mapper = ref_aligner.get_replacement_mapper(prompts, tok, max_len=L).float()
+    cross_alpha = ref_ptp.get_time_words_attention_alpha(
+        prompts, NUM_STEPS, CROSS_REPLACE, tok, max_num_words=L).float()
+    base_make_hook = _make_edit_hook(
+        "replace", mapper, cross_alpha,
+        self_window=(0, int(NUM_STEPS * SELF_REPLACE)))
+
+    # One-hot word masks per prompt via the reference's own get_word_inds
+    # (`/root/reference/main.py:58-64`).
+    alpha_layers = torch.zeros(len(prompts), L)
+    for i, (p, ws) in enumerate(zip(prompts, blend_words)):
+        for w in ws:
+            alpha_layers[i, ref_ptp.get_word_inds(p, w, tok)] = 1.0
+
+    # Running store of post-edit cond-half cross maps at the blend
+    # resolution, summed across steps in site call order (the reference's
+    # AttentionStore accumulation, `/root/reference/main.py:135-142`).
+    acc = {}
+    occ = {"i": 0}
+    blend_pixels = blend_res * blend_res
+
+    def make_hook(step):
+        inner = base_make_hook(step)
+        occ["i"] = 0
+
+        def hook(attn, is_cross):
+            out = inner(attn, is_cross)
+            if is_cross and out.shape[2] == blend_pixels:
+                b = out.shape[0] // 2
+                i = occ["i"]
+                occ["i"] += 1
+                acc[i] = acc.get(i, 0) + out[b:]
+            return out
+        return hook
+
+    start_blend_steps = int(start_blend_frac * NUM_STEPS)
+    n = len(prompts)
+
+    def post_step(step, latents):
+        maps = torch.cat(
+            [acc[i].reshape(n, -1, blend_res, blend_res, L)
+             for i in range(len(acc))], dim=1)
+        weighted = (maps * alpha_layers[:, None, None, None, :]).sum(-1).mean(1)
+        pooled = torch.nn.functional.max_pool2d(
+            weighted[:, None], 3, stride=1, padding=1)
+        up = torch.nn.functional.interpolate(
+            pooled, size=latents.shape[-2:], mode="nearest")[:, 0]
+        m = up / up.amax(dim=(1, 2), keepdim=True).clamp_min(1e-20)
+        m = m > 0.3
+        m = m[:1] | m
+        mf = m[:, None].float()
+        blended = latents[:1] + mf * (latents - latents[:1])
+        return blended if step + 1 > start_blend_steps else latents
+
+    enc = _torch_text_encode(cfg, pipe.text_params, tok,
+                             list(prompts) + [""] * len(prompts))
+    ctx = torch.cat([enc[len(prompts):], enc[:len(prompts)]], dim=0)
+
+    want_img = _torch_cfg_sample(pipe, cfg, ctx, x_t, n, make_hook,
+                                 GUIDANCE, NUM_STEPS, post_step=post_step)
 
     diff = np.abs(got_img.astype(np.int32) - want_img.astype(np.int32))
     assert diff.max() <= 1, (
