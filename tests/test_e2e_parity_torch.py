@@ -811,3 +811,52 @@ def test_text2image_local_blend_matches_torch_pipeline():
     assert diff.max() <= 1, (
         f"max pixel diff {diff.max()}, mean {diff.mean():.4f}")
     assert diff.mean() < 0.05
+
+
+def test_spatial_replace_and_negative_prompt_match_torch_pipeline():
+    """The two remaining sampling-surface features e2e: SpatialReplace
+    (structure injection by copying the source latent for the first
+    ``(1−stop_inject)·T`` steps, `/root/reference/null_text.py:158-168`) and
+    a negative prompt replacing the ``""`` unconditional text (a capability
+    the reference lacks; CFG then steers away from it)."""
+    cfg = TINY
+    tok = HashWordTokenizer(model_max_length=cfg.text.max_length)
+    prompts = PROMPTS_BY_MODE["replace"]
+    negative = "blurry low quality"
+    stop_inject = 0.4                       # inject steps 0..int(0.6·3)-1 = 0
+    pipe = Pipeline(
+        config=cfg,
+        unet_params=init_unet(jax.random.PRNGKey(0), cfg.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(1), cfg.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae),
+        tokenizer=tok,
+    )
+    x_t = jax.random.normal(jax.random.PRNGKey(5),
+                            (1,) + pipe.latent_shape, jnp.float32)
+
+    controller = factory.spatial_replace(NUM_STEPS, stop_inject)
+    got_img, _, _ = text2image(pipe, prompts, controller, num_steps=NUM_STEPS,
+                               guidance_scale=GUIDANCE, scheduler="ddim",
+                               latent=x_t, negative_prompt=negative)
+    got_img = np.asarray(got_img)
+
+    # Torch loop: no attention edits; uncond rows encode the negative prompt;
+    # the post-step hook broadcasts latent 0 while step < stop_inject steps.
+    enc = _torch_text_encode(cfg, pipe.text_params, tok,
+                             list(prompts) + [negative] * len(prompts))
+    ctx = torch.cat([enc[len(prompts):], enc[:len(prompts)]], dim=0)
+    inject_until = int((1 - stop_inject) * NUM_STEPS)
+
+    def post_step(step, latents):
+        if step < inject_until:
+            return latents[:1].expand_as(latents).clone()
+        return latents
+
+    want_img = _torch_cfg_sample(pipe, cfg, ctx, x_t, len(prompts),
+                                 lambda step: None, GUIDANCE, NUM_STEPS,
+                                 post_step=post_step)
+
+    diff = np.abs(got_img.astype(np.int32) - want_img.astype(np.int32))
+    assert diff.max() <= 1, (
+        f"max pixel diff {diff.max()}, mean {diff.mean():.4f}")
+    assert diff.mean() < 0.05
