@@ -128,6 +128,7 @@ def test_cli_generate_with_checkpoint_dir(checkpoint_dir, tmp_path):
     root, _ = checkpoint_dir
     out = tmp_path / "gen.png"
     rc = cli.main(["generate", "--preset", "tiny", "--checkpoint", root,
-                   "--prompt", "a cat", "--steps", "2", "--out", str(out)])
-    assert rc in (0, None)
+                   "--prompt", "a cat", "--steps", "2", "--quiet",
+                   "--out", str(out)])
+    assert rc == 0
     assert out.exists() and out.stat().st_size > 0
