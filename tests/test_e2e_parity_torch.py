@@ -1,11 +1,16 @@
-"""End-to-end sampling-loop parity vs a hand-rolled torch reference pipeline.
+"""End-to-end sampling-loop parity vs hand-rolled torch reference pipelines.
 
-The module-level oracles (tests/test_parity_torch.py) prove each block; this
-test proves the *composition* the north star calls "pixel-matching the PyTorch
-reference": tokenize → CLIP text encode → CFG batch-doubling → per-layer
-attention hook applying AttentionReplace → DDIM update → VAE decode → uint8,
-run once through our jitted `text2image` and once through an independent torch
-loop written against the reference's semantics:
+The module-level oracles (tests/test_parity_torch.py) prove each block; these
+ten tests prove the *composition* the north star calls "pixel-matching the
+PyTorch reference": tokenize → text encode → CFG batch-doubling → per-layer
+attention hook → scheduler update → (LocalBlend/SpatialReplace latent hook) →
+VAE decode → uint8, run once through our jitted `text2image` and once through
+an independent torch loop written against the reference's semantics. Covered
+end to end: Replace / Refine / chained Reweight, ε- and v-prediction, DDIM
+and PLMS, the LDM VQ backend, LocalBlend, SpatialReplace + negative prompt,
+the null-text replay path (per-step uncond embeddings), and null-text
+inversion itself (torch.optim.Adam vs our closed-form while_loop). Shared
+ingredients:
 
 - loop structure and CFG combine: `/root/reference/ptp_utils.py:65-76,129-172`
 - controller math: `/root/reference/main.py:85-98,162-230` (cond-half-only
