@@ -12,3 +12,32 @@ sharding over TPU meshes for seed / equalizer sweeps.
 __version__ = "0.1.0"
 
 MAX_NUM_WORDS = 77  # CLIP context length; the reference's `MAX_NUM_WORDS` (main.py:21)
+
+# Lazy top-level re-exports of the core user surface (PEP 562): keeps
+# `import p2p_tpu` light (no jax/flax import) while letting users write
+# `from p2p_tpu import text2image, Pipeline, make_controller, invert, ...`.
+_EXPORTS = {
+    "Pipeline": "p2p_tpu.engine.sampler",
+    "text2image": "p2p_tpu.engine.sampler",
+    "invert": "p2p_tpu.engine.inversion",
+    "InversionArtifact": "p2p_tpu.engine.inversion",
+    "load_image": "p2p_tpu.engine.inversion",
+    "load_pipeline": "p2p_tpu.models.checkpoint",
+    "make_controller": "p2p_tpu.controllers.factory",
+}
+
+__all__ = ["MAX_NUM_WORDS", *_EXPORTS]
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        value = getattr(importlib.import_module(_EXPORTS[name]), name)
+        globals()[name] = value  # cache: later accesses are plain dict hits
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
