@@ -9,6 +9,20 @@ first backend use; see tests/conftest.py). Structure:
   hang can never eat the whole round; fall back to a CPU measurement in a
   scrubbed env; as a last resort print a "backend_unavailable" line.
 
+Every stage budget is carved from ONE total deadline (_DEADLINE_S) so the
+worst-case wall time stays inside the external driver-timeout regime — a
+crash-retry can never stack a second full leash on top of the first. The
+measurement child prints its current-best JSON line after *every* variant
+(single-group, each g of the batched sweep, DPM secondary), and the parent
+parses the last line even out of a timeout kill, so sweeping variants can
+only improve the reported number, never lose it.
+
+The operating-point sweep: the batched variant vmaps g independent edit
+groups (g ∈ {2, 4, 8} as time allows; U-Net batch 4g with CFG); the best
+variant is reported by name. A quality-matched secondary metric runs
+DPM-Solver++(2M) at 20 steps (~50-step-DDIM quality, PERF.md) and lands in
+the same JSON line as "dpm20_imgs_per_s".
+
 Baseline: ≥4 img/s/chip on TPU (driver north star, BASELINE.md). Weights are
 random-init (no checkpoint in the image) — throughput is weight-agnostic.
 """
@@ -19,6 +33,12 @@ import os
 import subprocess
 import sys
 import time
+
+# Total wall budget (s). The external driver regime is ~30 min; leave slack
+# for interpreter startup and the final print.
+_DEADLINE_S = 1560
+# Reserved for the CPU tiny fallback (rehearsed: ~3 min warm cache; give 7).
+_FALLBACK_RESERVE_S = 420
 
 
 def _cpu_env():
@@ -50,17 +70,8 @@ def _probe_accelerator(timeout=180, attempts=3, backoffs=(15, 45)):
 _TIMEOUT = object()  # sentinel: the inner subprocess hit its timeout
 
 
-def _run_inner(preset, env, timeout):
-    """Run the measurement subprocess; return the parsed JSON line, None on
-    a non-timeout failure, or the _TIMEOUT sentinel."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--inner", preset],
-            env=env, timeout=timeout, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True)
-    except subprocess.TimeoutExpired:
-        return _TIMEOUT
-    for line in reversed(proc.stdout.splitlines()):
+def _parse_last_json(text):
+    for line in reversed((text or "").splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
@@ -70,6 +81,27 @@ def _run_inner(preset, env, timeout):
             except json.JSONDecodeError:
                 continue
     return None
+
+
+def _run_inner(preset, env, timeout):
+    """Run the measurement subprocess; return the parsed JSON line, None on
+    a non-timeout failure, or the _TIMEOUT sentinel.
+
+    The child prints its current-best line after every completed variant, so
+    even a timeout kill mid-sweep yields the best measurement so far."""
+    env = dict(env)
+    env["P2P_BENCH_BUDGET_S"] = str(int(timeout))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--inner", preset],
+            env=env, timeout=timeout, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        return _parse_last_json(out) or _TIMEOUT
+    return _parse_last_json(proc.stdout)
 
 
 def main():
@@ -83,23 +115,33 @@ def main():
     if args.inner:
         return _measure(args.inner)
 
+    t0 = time.monotonic()
+
+    def remaining():
+        return _DEADLINE_S - (time.monotonic() - t0)
+
     preset = args.preset
     result = None
     if preset != "tiny" and _probe_accelerator():
-        # First attempt gets the long leash: a cold compile of the SD-1.4
-        # program is minutes of single-core XLA work before any step runs.
-        result = _run_inner("sd14", dict(os.environ), timeout=2400)
+        # First attempt gets the longest leash the deadline allows: a cold
+        # compile of the SD-1.4 program is minutes of single-core XLA work
+        # before any step runs. (The child reports its best-so-far after each
+        # variant, so a timeout here still usually returns a number.)
+        leash = min(1800, remaining() - _FALLBACK_RESERVE_S)
+        if leash > 120:
+            result = _run_inner("sd14", dict(os.environ), timeout=leash)
         if result is _TIMEOUT or result is None:
-            # Retry once. A crash/OOM gets the full leash again; an actual
-            # timeout gets a short one — a healthy lease finishes in minutes
-            # off the now-warm persistent compile cache, and a still-wedged
-            # lease shouldn't eat another 40.
-            retry_timeout = 900 if result is _TIMEOUT else 2400
-            time.sleep(30)
-            result = _run_inner("sd14", dict(os.environ),
-                                timeout=retry_timeout)
+            # Retry once within what's left of the same total budget — a
+            # healthy lease finishes in minutes off the now-warm persistent
+            # compile cache; a still-wedged lease falls through to the CPU
+            # fallback instead of eating a second full leash.
+            retry = min(900, remaining() - _FALLBACK_RESERVE_S - 30)
+            if retry > 120:
+                time.sleep(30)
+                result = _run_inner("sd14", dict(os.environ), timeout=retry)
     if result is _TIMEOUT or result is None:
-        result = _run_inner("tiny", _cpu_env(), timeout=900)
+        result = _run_inner("tiny", _cpu_env(),
+                            timeout=max(120, min(900, remaining())))
     if result is _TIMEOUT or result is None:
         result = {"metric": "backend_unavailable", "value": 0.0,
                   "unit": "img/s/chip", "vs_baseline": 0.0}
@@ -122,12 +164,21 @@ def _measure(preset):
     from p2p_tpu.models import vae as vae_mod
     from p2p_tpu.utils.tokenizer import HashWordTokenizer
 
+    t0 = time.monotonic()
+    budget = float(os.environ.get("P2P_BENCH_BUDGET_S", "1800"))
+
+    def time_left():
+        return budget - (time.monotonic() - t0)
+
     on_accel = preset == "sd14"
     cfg = SD14 if on_accel else TINY
     num_steps = 50 if on_accel else 4
     dtype = jnp.bfloat16 if on_accel else jnp.float32
 
-    tok = HashWordTokenizer(model_max_length=cfg.text.max_length)
+    # sequential=True: collision-free ids regardless of prompt corpus — a
+    # hash collision must never abort a measurement (VERDICT r2 weak #5).
+    tok = HashWordTokenizer(model_max_length=cfg.text.max_length,
+                            sequential=True)
     pipe = Pipeline(
         config=cfg,
         unet_params=init_unet(jax.random.PRNGKey(0), cfg.unet),
@@ -156,24 +207,46 @@ def _measure(preset):
             fn(i + 1)
         return n_runs / (time.perf_counter() - t0)
 
-    imgs_per_s = timed(run) * len(prompts)
+    baseline = 4.0  # img/s/chip target (BASELINE.md north star)
+    metric = (f"sd14_512_replace_edit_{num_steps}step_imgs_per_s"
+              if on_accel else "tiny_cpu_fallback_imgs_per_s")
+    best = {"value": 0.0, "variant": "single_group"}
+    extras = {}
 
-    variant = "single_group"
+    def report():
+        # Current-best line after every variant: the parent parses the last
+        # JSON line even out of a timeout kill, so a sweep can only improve
+        # the reported number, never lose it.
+        print(json.dumps({
+            "metric": metric,
+            "value": round(best["value"], 4),
+            "unit": "img/s/chip",
+            # The baseline is defined for the SD-1.4 TPU workload; a
+            # tiny-model CPU fallback rate is not comparable to it, so report
+            # 0 rather than a meaningless (and flattering) ratio.
+            "vs_baseline": (round(best["value"] / baseline, 4)
+                            if on_accel else 0.0),
+            "variant": best["variant"],
+            **extras,
+        }), flush=True)
+
+    best["value"] = timed(run) * len(prompts)
+    report()
+
     if on_accel:
-        # Throughput variant: 2 independent edit groups vmapped on the one
-        # chip (the seed-sweep batching PERF.md documents; ~48% vs 43% MFU).
+        # Operating-point sweep: g independent edit groups vmapped on the one
+        # chip (the seed-sweep batching PERF.md documents; batch-8 U-Net was
+        # its MFU peak → g=2 first, then widen while the budget allows).
         # Guarded: a failure here must not discard the measurement above.
         try:
             from p2p_tpu.engine.sampler import encode_prompts
             from p2p_tpu.parallel import seed_latents, sweep
 
-            g = 2
-            ctrls = jax.tree_util.tree_map(
-                lambda x: jnp.broadcast_to(x, (g,) + x.shape), controller)
-
-            def run_batched(seed):
+            def run_batched(g, seed):
                 # Prompt encoding stays inside the timed region, matching
                 # what text2image times for the single-group variant.
+                ctrls = jax.tree_util.tree_map(
+                    lambda x: jnp.broadcast_to(x, (g,) + x.shape), controller)
                 cond = encode_prompts(pipe, prompts, dtype=dtype)
                 uncond = encode_prompts(pipe, [""] * len(prompts), dtype=dtype)
                 ctx = jnp.concatenate([uncond, cond], axis=0)
@@ -184,26 +257,48 @@ def _measure(preset):
                                 mesh=None)
                 return np.asarray(imgs)
 
-            batched = timed(run_batched) * g * len(prompts)
-            if batched > imgs_per_s:
-                imgs_per_s = batched
-                variant = f"batched_{g}groups"
-        except Exception as e:  # keep the single-group number
+            for g in (2, 4, 8):
+                # Each g is a fresh XLA program: leave room for its compile
+                # plus the timed runs (~4 sampling passes) before the kill.
+                if time_left() < 300:
+                    print(f"g-sweep stopped before g={g}: "
+                          f"{time_left():.0f}s left", file=sys.stderr)
+                    break
+                rate = timed(lambda s, g=g: run_batched(g, s)) * g * len(prompts)
+                extras[f"batched_{g}groups_imgs_per_s"] = round(rate, 4)
+                if rate > best["value"]:
+                    best.update(value=rate, variant=f"batched_{g}groups")
+                report()
+        except Exception as e:  # keep the best number so far
             print(f"batched variant failed ({type(e).__name__}: {e}); "
-                  f"reporting single-group", file=sys.stderr)
+                  f"reporting {best['variant']}", file=sys.stderr)
 
-    baseline = 4.0  # img/s/chip target (BASELINE.md north star)
-    print(json.dumps({
-        "metric": f"sd14_512_replace_edit_{num_steps}step_imgs_per_s"
-                  if on_accel else "tiny_cpu_fallback_imgs_per_s",
-        "value": round(imgs_per_s, 4),
-        "unit": "img/s/chip",
-        # The baseline is defined for the SD-1.4 TPU workload; a tiny-model
-        # CPU fallback rate is not comparable to it, so report 0 rather than
-        # a meaningless (and flattering) ratio.
-        "vs_baseline": round(imgs_per_s / baseline, 4) if on_accel else 0.0,
-        "variant": variant,
-    }))
+        # Quality-matched secondary: DPM-Solver++(2M) at 20 steps reaches
+        # ~50-step-DDIM quality (PERF.md) — the practical operating point.
+        if time_left() > 300:
+            try:
+                def run_dpm(seed):
+                    img, _, _ = text2image(
+                        pipe, prompts, controller_dpm, num_steps=20,
+                        scheduler="dpm", rng=jax.random.PRNGKey(seed),
+                        dtype=dtype)
+                    return np.asarray(img)
+
+                controller_dpm = factory.attention_replace(
+                    prompts, 20, cross_replace_steps=0.8,
+                    self_replace_steps=0.4, tokenizer=tok,
+                    self_max_pixels=16 * 16, max_len=cfg.text.max_length)
+                extras["dpm20_imgs_per_s"] = round(
+                    timed(run_dpm) * len(prompts), 4)
+                report()
+            except Exception as e:
+                print(f"dpm secondary failed ({type(e).__name__}: {e})",
+                      file=sys.stderr)
+        else:
+            print(f"dpm secondary skipped: {time_left():.0f}s left",
+                  file=sys.stderr)
+
+    report()
     return 0
 
 

