@@ -181,6 +181,19 @@ def quick_gelu(x):
     return x * jax.nn.sigmoid(1.702 * x)
 
 
+def upsample_nearest_2x(x: jax.Array) -> jax.Array:
+    """Exact 2× nearest-neighbor upsample of an NHWC tensor.
+
+    Bit-identical to ``jax.image.resize(..., method="nearest")`` at integer
+    scale 2 (each output pixel reads input ``i // 2``), but expressed as
+    broadcast+reshape so XLA lowers it to a tiled copy instead of the gather
+    the general resize op can produce — this sits on the U-Net's per-step
+    up path (3 levels × 50 steps) and the VAE decoder."""
+    b, h, w, c = x.shape
+    x = jnp.broadcast_to(x[:, :, None, :, None, :], (b, h, 2, w, 2, c))
+    return x.reshape(b, h * 2, w * 2, c)
+
+
 def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10000.0,
                        dtype=jnp.float32) -> jax.Array:
     """Sinusoidal timestep embedding, diffusers `Timesteps` semantics

@@ -350,9 +350,7 @@ def apply_unet(
             if block["attns"]:
                 h = _apply_spatial_transformer(block["attns"][i], h, context, cfg, ctx)
         if "upsample" in block:
-            b_, hh, ww, cc = h.shape
-            h = jax.image.resize(h, (b_, hh * 2, ww * 2, cc), method="nearest")
-            h = nn.conv2d(block["upsample"], h)
+            h = nn.conv2d(block["upsample"], nn.upsample_nearest_2x(h))
 
     assert ctx.cursor == len(layout.metas), (
         f"attention layout mismatch: model has {ctx.cursor} sites, "

@@ -202,9 +202,7 @@ def decode(params: Params, cfg: VAEConfig, latents: jax.Array) -> jax.Array:
         for resnet in block["resnets"]:
             h = _apply_resnet(resnet, h, g)
         if "upsample" in block:
-            b_, hh, ww, cc = h.shape
-            h = jax.image.resize(h, (b_, hh * 2, ww * 2, cc), method="nearest")
-            h = nn.conv2d(block["upsample"], h)
+            h = nn.conv2d(block["upsample"], nn.upsample_nearest_2x(h))
     return nn.conv2d(p["conv_out"], nn.silu(nn.group_norm(p["norm_out"], h, g)))
 
 

@@ -9,6 +9,7 @@ excluding inherent input quantization."""
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from p2p_tpu.models import nn
@@ -67,3 +68,13 @@ def test_layer_norm_bf16_matches_f32_oracle_on_same_input(mean, std):
     ref = (xf - m) / np.sqrt(v + 1e-5)
     got = np.asarray(nn.layer_norm(p, xb)).astype(np.float32)
     assert np.abs(got - ref).max() < 0.1
+
+
+def test_upsample_nearest_2x_matches_jax_image_resize():
+    rng = np.random.RandomState(3)
+    for shape in ((2, 4, 4, 3), (1, 8, 16, 5), (3, 1, 1, 2)):
+        x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+        b, h, w, c = shape
+        want = jax.image.resize(x, (b, h * 2, w * 2, c), method="nearest")
+        got = nn.upsample_nearest_2x(x)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
