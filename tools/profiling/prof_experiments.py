@@ -80,6 +80,36 @@ unet_mod.nn.fused_attention = orig_fused
 for B in (8, 16):
     time_scan(B, "baseline batchscale", steps=25)
 
+# 4b. head_dim pad 40→64 at the flash sites (MXU lane-efficiency probe;
+# semantically exact: zero-padded q/k leave logits unchanged, padded v dims
+# are sliced off). Theory says XLA/Mosaic pad internally and this is a wash —
+# measure to confirm.
+def fused_pad64(q, k, v, scale, mask=None):
+    d = q.shape[-1]
+    if mask is None and q.shape[-2] == k.shape[-2] and q.shape[-2] >= 2048 and d < 64:
+        pad = [(0, 0)] * (q.ndim - 1) + [(0, 64 - d)]
+        out = orig_fused(jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                         scale)
+        return out[..., :d]
+    return orig_fused(q, k, v, scale, mask)
+nn_mod.fused_attention = fused_pad64
+unet_mod.nn.fused_attention = fused_pad64
+time_scan(4, "flash head_dim pad64")
+nn_mod.fused_attention = orig_fused
+unet_mod.nn.fused_attention = orig_fused
+
+# 4c. old gather-based upsample (pre-round-3) vs the landed broadcast+reshape
+# — quantifies the relayout win on-chip.
+orig_up = nn_mod.upsample_nearest_2x
+def upsample_resize(x):
+    b, h, w, c = x.shape
+    return jax.image.resize(x, (b, h * 2, w * 2, c), method="nearest")
+nn_mod.upsample_nearest_2x = upsample_resize
+unet_mod.nn.upsample_nearest_2x = upsample_resize
+time_scan(4, "upsample via image.resize")
+nn_mod.upsample_nearest_2x = orig_up
+unet_mod.nn.upsample_nearest_2x = orig_up
+
 # 5. VAE decode bf16 vs f32
 vparams = vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae)
 for dt, name in ((jnp.float32, "vae f32"), (jnp.bfloat16, "vae bf16")):

@@ -17,6 +17,13 @@ VARIANTS = {
     "async_streams": "--xla_tpu_enable_async_collective_fusion=true",
     "latency_vmem": ("--xla_tpu_enable_latency_hiding_scheduler=true "
                      "--xla_tpu_scoped_vmem_limit_kib=131072"),
+    # Data-formatting attack (the 11% relayout share in the round-2 trace).
+    # Unknown-flag variants fail at backend init in seconds and are reported
+    # FAILED by the sweep — they never cost real chip time.
+    "sched_features": "--xla_tpu_enable_all_experimental_scheduler_features=true",
+    "vmem_192m": "--xla_tpu_scoped_vmem_limit_kib=196608",
+    "latency_vmem192": ("--xla_tpu_enable_latency_hiding_scheduler=true "
+                        "--xla_tpu_scoped_vmem_limit_kib=196608"),
 }
 
 
