@@ -255,6 +255,17 @@ def flash_block(seq_len: int) -> int:
     return next((b for b in (1024, 512, 256) if seq_len % b == 0), 0)
 
 
+def _flash_block_sizes(blk: int):
+    """The one BlockSizes geometry every flash call site uses — forward and
+    residuals variants must stay on the same tiling."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as _fa
+
+    return _fa.BlockSizes(
+        block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+        block_q_major_dkv=blk, block_k_major_dkv=blk,
+        block_q_dkv=blk, block_k_dkv=blk)
+
+
 def flash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,
                         scale: float, blk: int) -> jax.Array:
     """The Pallas TPU flash kernel call `fused_attention` takes at the big
@@ -264,12 +275,22 @@ def flash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,
     real TPU benchmark sessions."""
     from jax.experimental.pallas.ops.tpu import flash_attention as _fa
 
-    sizes = _fa.BlockSizes(
-        block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
-        block_q_major_dkv=blk, block_k_major_dkv=blk,
-        block_q_dkv=blk, block_k_dkv=blk)
     return _fa.flash_attention(q, k, v, causal=False, sm_scale=scale,
-                               block_sizes=sizes)
+                               block_sizes=_flash_block_sizes(blk))
+
+
+def flash_attention_residuals(q: jax.Array, k: jax.Array, v: jax.Array,
+                              scale: float, blk: int):
+    """Flash kernel returning ``(out, l, m)`` — the normalized output plus
+    per-row softmax statistics (sum ``l`` and max ``m`` of the local logits).
+    These are the pieces ring attention needs to merge partial results across
+    devices without ever materializing local (Sq, Sk) scores
+    (`parallel/ring.py`). Semantics pinned by tests/test_flash_pallas.py in
+    interpret mode."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as _fa
+
+    return _fa._flash_attention(q, k, v, None, None, True, False, scale,
+                                _flash_block_sizes(blk), False)
 
 
 def _on_tpu() -> bool:

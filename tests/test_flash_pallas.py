@@ -89,3 +89,92 @@ def test_flash_block_selection():
     assert nn.flash_block(1024) == 1024
     assert nn.flash_block(768) == 256
     assert nn.flash_block(1000) == 0  # not tileable → einsum path
+
+
+def test_flash_residuals_semantics():
+    # (out, l, m) from the residuals variant: out normalized, l = row sum of
+    # exp(s - m), m = row max — the invariants ring attention's merge relies
+    # on (parallel/ring.py _block_attend use_flash path).
+    s, d = 512, 40
+    blk = 256
+    q, k, v = _rand_qkv(4, 1, 2, s, d, jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    with pltpu.force_tpu_interpret_mode():
+        out, l, m = nn.flash_attention_residuals(q, k, v, scale, blk)
+    sim = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k)) * scale
+    m_ref = sim.max(-1)
+    p = np.exp(sim - m_ref[..., None])
+    l_ref = p.sum(-1)
+    out_ref = np.einsum("bhqk,bhkd->bhqd", p, np.asarray(v)) / l_ref[..., None]
+    np.testing.assert_allclose(np.asarray(m), m_ref, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(l), l_ref, atol=1e-4, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out), out_ref, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_ring_attention_flash_chunks_parity():
+    # Flash-chunked ring vs einsum-chunked ring vs single-device reference,
+    # on a 4-device CPU mesh with 1024-pixel local chunks (the production
+    # long-context configuration, interpret mode standing in for TPU).
+    from jax.sharding import Mesh
+    from p2p_tpu.parallel.ring import ring_self_attention
+
+    devs = jax.devices("cpu")[:4]
+    mesh = Mesh(np.asarray(devs).reshape(4), ("sp",))
+    s, d = 4096, 40
+    q, k, v = _rand_qkv(5, 1, 2, s, d, jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    want = _ref(q, k, v, scale)
+    ring_einsum = ring_self_attention(q, k, v, scale, mesh, "sp",
+                                      use_flash=False)
+    np.testing.assert_allclose(np.asarray(ring_einsum), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    with pltpu.force_tpu_interpret_mode():
+        ring_flash = ring_self_attention(q, k, v, scale, mesh, "sp",
+                                         use_flash=True)
+    np.testing.assert_allclose(np.asarray(ring_flash), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_attention_flash_grad_falls_back_to_einsum():
+    # The flash chunk's custom VJP recomputes through the einsum block, so a
+    # differentiated sequence-parallel site (e.g. inversion under SpConfig)
+    # keeps working when use_flash=True.
+    from jax.sharding import Mesh
+    from p2p_tpu.parallel.ring import ring_self_attention
+
+    devs = jax.devices("cpu")[:2]
+    mesh = Mesh(np.asarray(devs).reshape(2), ("sp",))
+    s, d = 2048, 8  # local chunks of 1024 → flash-tileable
+    q, k, v = _rand_qkv(6, 1, 1, s, d, jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    def loss(fn_flash):
+        def f(q):
+            out = ring_self_attention(q, k, v, scale, mesh, "sp",
+                                      use_flash=fn_flash)
+            return jnp.sum(out * out)
+        return f
+
+    g_einsum = jax.grad(loss(False))(q)
+    with pltpu.force_tpu_interpret_mode():
+        g_flash = jax.grad(loss(True))(q)
+    np.testing.assert_allclose(np.asarray(g_flash), np.asarray(g_einsum),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_ring_attention_flash_nontileable_falls_back():
+    # use_flash=True with a non-tileable local chunk (250 pixels) must take
+    # the einsum path instead of building a zero-size Pallas grid.
+    from jax.sharding import Mesh
+    from p2p_tpu.parallel.ring import ring_self_attention
+
+    devs = jax.devices("cpu")[:2]
+    mesh = Mesh(np.asarray(devs).reshape(2), ("sp",))
+    s, d = 500, 8
+    q, k, v = _rand_qkv(7, 1, 1, s, d, jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    out = ring_self_attention(q, k, v, scale, mesh, "sp", use_flash=True)
+    want = _ref(q, k, v, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
