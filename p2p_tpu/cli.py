@@ -198,6 +198,14 @@ def cmd_replay(args) -> int:
     return 0
 
 
+def cmd_check(args) -> int:
+    from .models.checkpoint_check import _print_report, check_checkpoint
+
+    rep = check_checkpoint(args.checkpoint_dir, args.preset)
+    _print_report(rep)
+    return 0 if rep.ok else 1
+
+
 def _int_list(s: str) -> List[int]:
     return [int(x) for x in s.split(",") if x]
 
@@ -280,6 +288,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="edited prompt (omit for pure reconstruction)")
     r.add_argument("--out-dir", default=None)
     r.set_defaults(fn=cmd_replay)
+
+    c = sub.add_parser(
+        "check", help="checkpoint-readiness report (no weights loaded)")
+    c.add_argument("checkpoint_dir")
+    c.add_argument("--preset", required=True,
+                   choices=("sd14", "sd21", "sd21base", "ldm256"))
+    c.set_defaults(fn=cmd_check)
     return p
 
 

@@ -1,14 +1,13 @@
-"""Checkpoint-readiness tool (`tools/check_checkpoint.py`) against synthetic
+"""Checkpoint-readiness reports (`p2p_tpu.models.checkpoint_check`, surfaced
+as `p2p-tpu check` and `tools/check_checkpoint.py`) against synthetic
 diffusers-layout directories (VERDICT r2 item 5): a correct dir reports READY;
 shape drift, missing/unmapped tensors, scheduler-config drift, and missing
 tokenizer files each surface as a named problem instead of a load-time crash.
 """
 
-import importlib.util
 import json
 import os
 import shutil
-import sys
 
 import numpy as np
 import pytest
@@ -18,17 +17,10 @@ import jax
 
 from p2p_tpu.models import TINY, init_text_encoder, init_unet
 from p2p_tpu.models import vae as vae_mod
+from p2p_tpu.models import checkpoint_check as cc
 from p2p_tpu.models.checkpoint import (export_state_dict,
                                        text_encoder_entries, unet_entries,
                                        vae_entries)
-
-_SPEC = importlib.util.spec_from_file_location(
-    "check_checkpoint",
-    os.path.join(os.path.dirname(__file__), "..", "tools",
-                 "check_checkpoint.py"))
-cc = importlib.util.module_from_spec(_SPEC)
-sys.modules["check_checkpoint"] = cc  # dataclasses resolves cls.__module__
-_SPEC.loader.exec_module(cc)
 
 
 def _write_bin(sd, dirpath, filename):
@@ -90,6 +82,15 @@ def test_cli_exit_codes(good_dir, tmp_path, monkeypatch, capsys):
     monkeypatch.setitem(cc.__dict__, "check_checkpoint",
                         lambda d, p, config=None: cc.Report(preset=p))
     assert cc.main([str(tmp_path), "--preset", "sd14"]) == 0
+    assert "READY" in capsys.readouterr().out
+
+
+def test_p2p_tpu_cli_check_subcommand(good_dir, monkeypatch, capsys):
+    from p2p_tpu import cli
+
+    monkeypatch.setitem(cc.__dict__, "check_checkpoint",
+                        lambda d, p, config=None: cc.Report(preset=p))
+    assert cli.main(["check", good_dir, "--preset", "sd14"]) == 0
     assert "READY" in capsys.readouterr().out
 
 
