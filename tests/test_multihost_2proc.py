@@ -22,6 +22,8 @@ import pytest
 _WORKER = textwrap.dedent("""
     import sys
     sys.path.insert(0, {repo!r})
+    from p2p_tpu.utils.cache import enable_persistent_cache
+    enable_persistent_cache()
     from p2p_tpu.parallel import multihost
     import jax, jax.numpy as jnp
 
@@ -75,13 +77,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_dp_sweep(tmp_path):
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    script = tmp_path / "worker.py"
-    script.write_text(_WORKER.format(repo=repo))
-    port = _free_port()
-
+def _run_pair(script, port):
     def launch(pid):
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)  # axon plugin registers at
@@ -101,12 +97,28 @@ def test_two_process_dp_sweep(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=600)
+            out, _ = p.communicate(timeout=900)
             outs.append(out)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
-        assert "MH-WORKER-OK" in out, f"worker {pid} output:\n{out[-3000:]}"
+    problems = [f"worker {pid} rc={p.returncode}:\n{out[-3000:]}"
+                for pid, (p, out) in enumerate(zip(procs, outs))
+                if p.returncode != 0 or "MH-WORKER-OK" not in out]
+    return problems
+
+
+@pytest.mark.slow
+def test_two_process_dp_sweep(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.format(repo=repo))
+
+    problems = _run_pair(script, _free_port())
+    if problems:
+        # Distributed-runtime startup (coordinator connect, gloo rendezvous)
+        # can flake under a loaded single-core host; one clean retry on a
+        # fresh port distinguishes a flake from a real regression.
+        problems = _run_pair(script, _free_port())
+    assert not problems, "\n---\n".join(problems)
