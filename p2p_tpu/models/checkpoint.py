@@ -354,9 +354,23 @@ def load_vae(params: Any, cfg: VAEConfig, dirpath: str, strict: bool = True) -> 
     return apply_state_dict(params, vae_entries(cfg), sd, strict)
 
 
+def _find_subdir(checkpoint_dir: str, names: Tuple[str, ...]) -> str:
+    for n in names:
+        p = os.path.join(checkpoint_dir, n)
+        if os.path.isdir(p):
+            return p
+    raise FileNotFoundError(
+        f"no {'/'.join(names)} directory in {checkpoint_dir}")
+
+
 def load_pipeline(checkpoint_dir: str, config, tokenizer=None):
-    """Load a full SD checkpoint directory (diffusers layout: ``unet/``,
-    ``text_encoder/``, ``vae/``, ``tokenizer/``) into a Pipeline."""
+    """Load a full checkpoint directory into a Pipeline.
+
+    Accepts both diffusers layouts: SD repos (``unet/``, ``text_encoder/``,
+    ``vae/``, ``tokenizer/``) and the CompVis LDM repo's naming (``bert/``,
+    ``vqvae/``) — the two directory trees the reference's
+    ``from_pretrained`` calls resolve (`/root/reference/main.py:29`,
+    LDM per SURVEY §3.3)."""
     import jax
 
     from ..engine.sampler import Pipeline
@@ -366,12 +380,12 @@ def load_pipeline(checkpoint_dir: str, config, tokenizer=None):
     from . import vae as vae_mod
 
     unet_params = load_unet(init_unet(jax.random.PRNGKey(0), config.unet),
-                            config.unet, os.path.join(checkpoint_dir, "unet"))
+                            config.unet, _find_subdir(checkpoint_dir, ("unet",)))
     text_params = load_text_encoder(
         init_text_encoder(jax.random.PRNGKey(0), config.text), config.text,
-        os.path.join(checkpoint_dir, "text_encoder"))
+        _find_subdir(checkpoint_dir, ("text_encoder", "bert")))
     vae_params = load_vae(vae_mod.init_vae(jax.random.PRNGKey(0), config.vae),
-                          config.vae, os.path.join(checkpoint_dir, "vae"))
+                          config.vae, _find_subdir(checkpoint_dir, ("vae", "vqvae")))
     if tokenizer is None:
         tok_dir = os.path.join(checkpoint_dir, "tokenizer")
         max_len = config.text.max_length

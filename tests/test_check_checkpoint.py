@@ -146,3 +146,38 @@ def test_shape_transforms():
     assert cc._shape_fwd("linear", (8, 4)) == (4, 8)
     assert cc._shape_fwd("conv", (16, 8, 3, 3)) == (3, 3, 8, 16)
     assert cc._shape_fwd("none", (9,)) == (9,)
+
+
+def test_ldm_layout_bert_vqvae_dirs(tmp_path):
+    # The CompVis LDM-256 repo names its sub-models bert/ and vqvae/; both
+    # the readiness check and load_pipeline must resolve that layout.
+    from p2p_tpu.models import TINY_LDM
+    from p2p_tpu.models.checkpoint import (ldm_text_encoder_entries,
+                                           load_pipeline)
+
+    root = str(tmp_path / "ldm")
+    cfg = TINY_LDM
+    _write_bin(export_state_dict(init_unet(jax.random.PRNGKey(0), cfg.unet),
+                                 unet_entries(cfg.unet)),
+               os.path.join(root, "unet"), "diffusion_pytorch_model.bin")
+    _write_bin(export_state_dict(
+        init_text_encoder(jax.random.PRNGKey(1), cfg.text),
+        ldm_text_encoder_entries(cfg.text)),
+        os.path.join(root, "bert"), "pytorch_model.bin")
+    _write_bin(export_state_dict(vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae),
+                                 vae_entries(cfg.vae)),
+               os.path.join(root, "vqvae"), "diffusion_pytorch_model.bin")
+    tok = os.path.join(root, "tokenizer")
+    os.makedirs(tok, exist_ok=True)
+    with open(os.path.join(tok, "vocab.txt"), "w") as f:
+        f.write("\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "a", "cat",
+                           "##s"]) + "\n")
+
+    rep = cc.check_checkpoint(root, "ldm256", config=cfg)
+    for s in rep.submodels:
+        assert s.error is None and not s.missing and not s.shape_mismatches, vars(s)
+    assert rep.tokenizer_error is None
+    assert rep.scheduler_error is not None  # no scheduler json → warning only
+
+    pipe = load_pipeline(root, cfg)
+    assert pipe.tokenizer.model_max_length == cfg.text.max_length
