@@ -242,11 +242,9 @@ def _measure(preset):
             from p2p_tpu.engine.sampler import encode_prompts
             from p2p_tpu.parallel import seed_latents, sweep
 
-            def run_batched(g, seed):
+            def run_batched(g, ctrls, seed):
                 # Prompt encoding stays inside the timed region, matching
                 # what text2image times for the single-group variant.
-                ctrls = jax.tree_util.tree_map(
-                    lambda x: jnp.broadcast_to(x, (g,) + x.shape), controller)
                 cond = encode_prompts(pipe, prompts, dtype=dtype)
                 uncond = encode_prompts(pipe, [""] * len(prompts), dtype=dtype)
                 ctx = jnp.concatenate([uncond, cond], axis=0)
@@ -264,7 +262,10 @@ def _measure(preset):
                     print(f"g-sweep stopped before g={g}: "
                           f"{time_left():.0f}s left", file=sys.stderr)
                     break
-                rate = timed(lambda s, g=g: run_batched(g, s)) * g * len(prompts)
+                ctrls = jax.tree_util.tree_map(
+                    lambda x: jnp.broadcast_to(x, (g,) + x.shape), controller)
+                rate = (timed(lambda s, g=g, c=ctrls: run_batched(g, c, s))
+                        * g * len(prompts))
                 extras[f"batched_{g}groups_imgs_per_s"] = round(rate, 4)
                 if rate > best["value"]:
                     best.update(value=rate, variant=f"batched_{g}groups")
@@ -298,7 +299,6 @@ def _measure(preset):
             print(f"dpm secondary skipped: {time_left():.0f}s left",
                   file=sys.stderr)
 
-    report()
     return 0
 
 
