@@ -78,3 +78,31 @@ def test_upsample_nearest_2x_matches_jax_image_resize():
         want = jax.image.resize(x, (b, h * 2, w * 2, c), method="nearest")
         got = nn.upsample_nearest_2x(x)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_fused_attention_large_site_matches_reference_on_cpu():
+    # On a non-TPU backend, S >= 2048 routes through
+    # jax.nn.dot_product_attention — pin it against the materialized path.
+    rng = np.random.RandomState(4)
+    s, d = 2048, 16
+    mk = lambda: jnp.asarray(rng.randn(1, 2, s, d).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    scale = d ** -0.5
+    got = nn.fused_attention(q, k, v, scale)
+    probs = nn.attention_probs(q, k, scale).astype(v.dtype)
+    want = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_fused_attention_mask_uses_einsum_path():
+    rng = np.random.RandomState(5)
+    s, d = 64, 8
+    mk = lambda: jnp.asarray(rng.randn(1, 1, s, d).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    mask = jnp.where(jnp.arange(s)[None, None, None, :] > s // 2, -1e9, 0.0)
+    got = nn.fused_attention(q, k, v, d ** -0.5, mask)
+    probs = nn.attention_probs(q, k, d ** -0.5, mask).astype(v.dtype)
+    want = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-6, rtol=1e-6)
