@@ -67,6 +67,11 @@ _WORKER = textwrap.dedent("""
     assert len(imgs.addressable_shards) == 2
     own = list(multihost.process_groups(g))
     assert own == ([0, 1] if jax.process_index() == 0 else [2, 3]), own
+    # Explicit sync before exit: on the single-core build host the two
+    # workers' compiles serialize, so without this the faster worker exits
+    # minutes early and the 30s distributed-shutdown barrier times out.
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("workers-done")
     print("MH-WORKER-OK", flush=True)
 """)
 
