@@ -39,6 +39,16 @@ _WORKER = textwrap.dedent("""
     from p2p_tpu.parallel import seed_latents, sweep
     from p2p_tpu.utils.tokenizer import HashWordTokenizer
 
+    def barrier(name):
+        # Rendezvous through the coordination service, NOT a gloo
+        # collective: on the single-core build host the workers' compiles
+        # serialize and skew by minutes, while gloo's context handshake
+        # times out at a fixed ~30s. The coordination barrier takes a real
+        # timeout, so the first gloo op on each clique then happens with
+        # millisecond skew.
+        from jax._src import distributed
+        distributed.global_state.client.wait_at_barrier(name, 600_000)
+
     cfg = TINY
     tok = HashWordTokenizer(model_max_length=cfg.text.max_length)
     pipe = Pipeline(
@@ -60,6 +70,7 @@ _WORKER = textwrap.dedent("""
     ctx = jnp.broadcast_to(ctx[None], (g,) + ctx.shape)
     lats = seed_latents(jax.random.PRNGKey(3), g, len(prompts),
                         pipe.latent_shape)
+    barrier("pre-sweep")  # first gloo ops (sweep's device_puts) follow
     imgs, _ = sweep(pipe, ctx, lats, ctrls, num_steps=2, mesh=mesh)
     assert imgs.shape == (g, len(prompts), cfg.image_size, cfg.image_size, 3)
     # The group axis is genuinely sharded: this process holds 2 of 4 groups
@@ -67,11 +78,9 @@ _WORKER = textwrap.dedent("""
     assert len(imgs.addressable_shards) == 2
     own = list(multihost.process_groups(g))
     assert own == ([0, 1] if jax.process_index() == 0 else [2, 3]), own
-    # Explicit sync before exit: on the single-core build host the two
-    # workers' compiles serialize, so without this the faster worker exits
-    # minutes early and the 30s distributed-shutdown barrier times out.
-    from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices("workers-done")
+    # Explicit sync before exit: without it the faster worker exits minutes
+    # early and the 30s distributed-shutdown barrier times out.
+    barrier("workers-done")
     print("MH-WORKER-OK", flush=True)
 """)
 
