@@ -178,3 +178,27 @@ def test_ring_attention_flash_nontileable_falls_back():
     want = _ref(q, k, v, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_ring_merge_matches_full_softmax_any_block_count():
+    # The (acc, m, l) log-sum-exp merge must reproduce the full softmax over
+    # concatenated k/v for any split — the invariant the ppermute ring rests
+    # on (parallel/ring.py _merge).
+    from p2p_tpu.parallel.ring import _block_attend, _merge
+
+    rng = np.random.RandomState(8)
+    b, h, sq, d = 1, 2, 64, 8
+    q = jnp.asarray(rng.randn(b, h, sq, d).astype(np.float32))
+    scale = 1.0 / np.sqrt(d)
+    for n_blocks in (2, 3, 5):
+        ks = [jnp.asarray(rng.randn(b, h, 32, d).astype(np.float32))
+              for _ in range(n_blocks)]
+        vs = [jnp.asarray(rng.randn(b, h, 32, d).astype(np.float32))
+              for _ in range(n_blocks)]
+        acc, m, l = _block_attend(q, ks[0], vs[0], scale)
+        for k, v in zip(ks[1:], vs[1:]):
+            acc, m, l = _merge(acc, m, l, *_block_attend(q, k, v, scale))
+        got = np.asarray(acc / l[..., None])
+        want = np.asarray(_ref(q, jnp.concatenate(ks, axis=2),
+                               jnp.concatenate(vs, axis=2), scale))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
