@@ -181,3 +181,38 @@ def test_ldm_layout_bert_vqvae_dirs(tmp_path):
 
     pipe = load_pipeline(root, cfg)
     assert pipe.tokenizer.model_max_length == cfg.text.max_length
+
+
+@pytest.mark.parametrize("preset", ["sd14", "sd21", "sd21base", "ldm256"])
+def test_fullscale_preset_tables_consistent(preset):
+    # Every real preset's mapping tables must agree with its init tree at
+    # FULL scale: each mapped path exists with a defined shape (eval_shape —
+    # no allocation). This is the U-Net/VAE analogue of the full-scale text
+    # validation in test_text_encoder_fullscale.py: a drifted entry table or
+    # config (wrong level count, head_dim, channel_mults) fails here, not on
+    # first real-weights contact.
+    from p2p_tpu.models import config as cfg_mod
+    from p2p_tpu.models import vae as vae_mod
+    from p2p_tpu.models.checkpoint import (ldm_text_encoder_entries,
+                                           text_encoder_entries, unet_entries,
+                                           vae_entries)
+    from p2p_tpu.models.text_encoder import init_text_encoder
+    from p2p_tpu.models.unet import init_unet
+
+    cfg = {"sd14": cfg_mod.SD14, "sd21": cfg_mod.SD21,
+           "sd21base": cfg_mod.SD21_BASE, "ldm256": cfg_mod.LDM256}[preset]
+    text_entries = (ldm_text_encoder_entries(cfg.text)
+                    if cfg.text.arch == "ldmbert"
+                    else text_encoder_entries(cfg.text))
+    for entries, init_fn, floor in (
+            (unet_entries(cfg.unet), lambda k: init_unet(k, cfg.unet), 400),
+            (text_entries,
+             lambda k: init_text_encoder(k, cfg.text), 100),
+            (vae_entries(cfg.vae),
+             lambda k: vae_mod.init_vae(k, cfg.vae), 100)):
+        shapes = cc._expected_shapes(entries, init_fn)
+        assert len(shapes) >= floor
+        assert all(len(s) > 0 for _, s in shapes.values())
+        # their-names must be unique — duplicate targets would silently
+        # overwrite on export.
+        assert len(shapes) == len(entries)
