@@ -230,17 +230,19 @@ def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array, scale: float,
 
     q,k,v: (B, heads, S, D); mask: additive, broadcastable to
     (B, heads, Sq, Sk). Large self-attention (S ≥ 2048, e.g. the 64²-pixel
-    sites) runs the Pallas TPU flash kernel — blockwise, never materializing
-    the (S, S) probability tensor; measured ~3× over XLA's attention at the
-    SD-1.4 64² shape on v5e. Small maps use a plain einsum chain (kernel
-    launch would cost more than it saves)."""
+    sites) runs the Pallas TPU flash kernel when ``flash_block`` finds a
+    VMEM-feasible block for the head geometry — blockwise, never
+    materializing the (S, S) probability tensor; measured ~3× over XLA's
+    attention at the SD-1.4 64² shape on v5e. Small maps use a plain einsum
+    chain (kernel launch would cost more than it saves)."""
     s_q, s_k = q.shape[-2], k.shape[-2]
     if mask is None and s_q == s_k and s_q >= 2048:
-        blk = flash_block(s_q)
+        blk = flash_block(s_q, q.shape[-1], q.dtype.itemsize)
         if blk and _on_tpu():
             return flash_attention_tpu(q, k, v, scale, blk)
-        # Non-TPU accelerators: let XLA pick its attention lowering rather
-        # than materializing the (S, S) probabilities explicitly.
+        # Non-TPU accelerators, or no VMEM-feasible block for this head
+        # geometry: let XLA pick its attention lowering rather than
+        # materializing the (S, S) probabilities explicitly.
         out = jax.nn.dot_product_attention(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
             v.transpose(0, 2, 1, 3), scale=scale)
@@ -249,10 +251,24 @@ def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array, scale: float,
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def flash_block(seq_len: int) -> int:
+# Stay under the TPU's 16 MiB scoped-VMEM budget with headroom: the flash
+# kernel's resident footprint per grid step is ~(q + k + v + double-buffered
+# k/v) blocks in the input dtype plus f32 accumulator/statistics scratch,
+# ≈ block·head_dim·(8·itemsize + 8) bytes (within ~5% of the 19 MiB the
+# compiler reports for block 1024, D=512, f32 — the VAE mid-attention shape
+# that OOMs scoped vmem if block size ignores head_dim).
+_FLASH_VMEM_BUDGET = 14 * 2**20
+
+
+def flash_block(seq_len: int, head_dim: int = 128, itemsize: int = 2) -> int:
     """Largest power-of-two block that tiles ``seq_len`` (the Pallas kernel
-    requires seq_len % block == 0); 0 → shape not tileable."""
-    return next((b for b in (1024, 512, 256) if seq_len % b == 0), 0)
+    requires seq_len % block == 0) AND keeps the kernel's scoped-VMEM
+    footprint inside the TPU budget for this ``head_dim``/``itemsize``;
+    0 → no viable block (einsum/XLA path instead)."""
+    for b in (1024, 512, 256):
+        if seq_len % b == 0 and b * head_dim * (8 * itemsize + 8) <= _FLASH_VMEM_BUDGET:
+            return b
+    return 0
 
 
 def _flash_block_sizes(blk: int):

@@ -50,7 +50,8 @@ def _block_attend_flash(q, k, v, scale):
     from ..models import nn
 
     o, l, m = nn.flash_attention_residuals(
-        q, k, v, scale, nn.flash_block(q.shape[-2]))
+        q, k, v, scale,
+        nn.flash_block(q.shape[-2], q.shape[-1], q.dtype.itemsize))
     # The kernel returns the *normalized* local output; the ring merge
     # needs the unnormalized accumulator acc = o·l.
     return o.astype(jnp.float32) * l[..., None].astype(jnp.float32), m, l
@@ -82,7 +83,8 @@ def _block_attend(q, k, v, scale, use_flash=False):
     from ..models import nn
 
     if (use_flash and q.shape[-2] == k.shape[-2]
-            and nn.flash_block(q.shape[-2]) > 0):
+            and nn.flash_block(q.shape[-2], q.shape[-1],
+                               q.dtype.itemsize) > 0):
         return _block_attend_flash(q, k, v, scale)
     return _block_attend_einsum(q, k, v, scale)
 
@@ -97,14 +99,15 @@ def _merge(acc1, m1, l1, acc2, m2, l2):
     return acc, m, l
 
 
-def _flash_chunk_ok(s_local: int) -> bool:
+def _flash_chunk_ok(s_local: int, head_dim: int, itemsize: int) -> bool:
     """Flash per-chunk pays off when the local chunk is big enough that
-    materializing (S_local, S_local) scores hurts, and tiles the kernel's
-    block grid. Below the threshold the einsum block is cheaper than a
-    kernel launch per ring round."""
+    materializing (S_local, S_local) scores hurts, and the kernel has a
+    viable block for this geometry (tiles the grid AND fits scoped VMEM).
+    Below the threshold the einsum block is cheaper than a kernel launch
+    per ring round."""
     from ..models import nn
 
-    return s_local >= 1024 and nn.flash_block(s_local) > 0
+    return s_local >= 1024 and nn.flash_block(s_local, head_dim, itemsize) > 0
 
 
 def ring_self_attention_shard(
@@ -154,7 +157,8 @@ def ring_self_attention(
     if use_flash is None:
         from ..models import nn
 
-        use_flash = nn._on_tpu() and _flash_chunk_ok(q.shape[2] // n)
+        use_flash = nn._on_tpu() and _flash_chunk_ok(
+            q.shape[2] // n, q.shape[-1], q.dtype.itemsize)
     spec = P(None, None, axis_name, None)
     # check_vma only off for the flash chunks: pallas_call does not yet carry
     # the varying-mesh-axes metadata shard_map's checker wants. The einsum

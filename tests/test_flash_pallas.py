@@ -83,12 +83,37 @@ def test_flash_interpret_parity_small_multiblock():
                                atol=1e-5, rtol=1e-5)
 
 
+def test_flash_interpret_parity_vae_head_geometry():
+    # The VAE decoder's mid-block attention runs the kernel with a single
+    # 512-wide head in f32 (models/vae.py) — the widest-head site in the
+    # framework. Reduced S keeps interpret mode fast; the block count (2×2)
+    # still exercises the online-softmax merge at this width.
+    s, d = 512, 512
+    blk = 256
+    q, k, v = _rand_qkv(3, 1, 1, s, d, jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    with pltpu.force_tpu_interpret_mode():
+        out = nn.flash_attention_tpu(q, k, v, scale, blk)
+    want = _ref(q, k, v, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=1e-4, rtol=1e-5)
+
+
 def test_flash_block_selection():
     assert nn.flash_block(4096) == 1024
     assert nn.flash_block(2048) == 1024
     assert nn.flash_block(1024) == 1024
     assert nn.flash_block(768) == 256
     assert nn.flash_block(1000) == 0  # not tileable → einsum path
+    # Scoped-VMEM-aware selection: the SD U-Net 64² site (bf16, D=40) keeps
+    # the largest block; the VAE mid-attention shape (f32, D=512) must step
+    # down — block 1024 there is the 19 MiB > 16 MiB compile-time OOM that
+    # killed the g≥4 sweep legs on the chip.
+    assert nn.flash_block(4096, 40, 2) == 1024
+    assert nn.flash_block(4096, 512, 4) == 512
+    assert nn.flash_block(4096, 512, 2) == 1024  # bf16 halves the footprint
+    # Absurdly wide heads: no viable block → 0 → einsum/XLA path.
+    assert nn.flash_block(4096, 4096, 4) == 0
 
 
 def test_flash_residuals_semantics():
