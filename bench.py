@@ -230,31 +230,45 @@ def _measure(preset):
             **extras,
         }), flush=True)
 
-    best["value"] = timed(run) * len(prompts)
+    rate1 = timed(run) * len(prompts)
+    best["value"] = rate1
+    extras["single_group_imgs_per_s"] = round(rate1, 4)
     report()
 
     if on_accel:
+        # Import failures here must degrade like any batched-variant failure
+        # (keep the single-group number; skip the variants that need these).
+        try:
+            from p2p_tpu.engine.sampler import encode_prompts
+            from p2p_tpu.parallel import seed_latents, sweep
+        except Exception as e:
+            print(f"batched variants unavailable ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            encode_prompts = seed_latents = sweep = None
+
+        def broadcast_groups(g, ctrl):
+            return jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(x, (g,) + x.shape), ctrl)
+
+        def run_batched(g, ctrls, seed, steps=num_steps, scheduler="ddim"):
+            # Prompt encoding stays inside the timed region, matching
+            # what text2image times for the single-group variant.
+            cond = encode_prompts(pipe, prompts, dtype=dtype)
+            uncond = encode_prompts(pipe, [""] * len(prompts), dtype=dtype)
+            ctx = jnp.concatenate([uncond, cond], axis=0)
+            ctx = jnp.broadcast_to(ctx[None], (g,) + ctx.shape)
+            lats = seed_latents(jax.random.PRNGKey(seed), g, len(prompts),
+                                pipe.latent_shape, dtype=dtype)
+            imgs, _ = sweep(pipe, ctx, lats, ctrls, num_steps=steps,
+                            scheduler=scheduler, mesh=None)
+            return np.asarray(imgs)
+
         # Operating-point sweep: g independent edit groups vmapped on the one
         # chip (the seed-sweep batching PERF.md documents; batch-8 U-Net was
         # its MFU peak → g=2 first, then widen while the budget allows).
         # Guarded: a failure here must not discard the measurement above.
-        try:
-            from p2p_tpu.engine.sampler import encode_prompts
-            from p2p_tpu.parallel import seed_latents, sweep
-
-            def run_batched(g, ctrls, seed):
-                # Prompt encoding stays inside the timed region, matching
-                # what text2image times for the single-group variant.
-                cond = encode_prompts(pipe, prompts, dtype=dtype)
-                uncond = encode_prompts(pipe, [""] * len(prompts), dtype=dtype)
-                ctx = jnp.concatenate([uncond, cond], axis=0)
-                ctx = jnp.broadcast_to(ctx[None], (g,) + ctx.shape)
-                lats = seed_latents(jax.random.PRNGKey(seed), g, len(prompts),
-                                    pipe.latent_shape, dtype=dtype)
-                imgs, _ = sweep(pipe, ctx, lats, ctrls, num_steps=num_steps,
-                                mesh=None)
-                return np.asarray(imgs)
-
+        if sweep is not None:
+          try:
             for g in (2, 4, 8):
                 # Each g is a fresh XLA program: leave room for its compile
                 # plus the timed runs (~4 sampling passes) before the kill.
@@ -262,15 +276,14 @@ def _measure(preset):
                     print(f"g-sweep stopped before g={g}: "
                           f"{time_left():.0f}s left", file=sys.stderr)
                     break
-                ctrls = jax.tree_util.tree_map(
-                    lambda x: jnp.broadcast_to(x, (g,) + x.shape), controller)
+                ctrls = broadcast_groups(g, controller)
                 rate = (timed(lambda s, g=g, c=ctrls: run_batched(g, c, s))
                         * g * len(prompts))
                 extras[f"batched_{g}groups_imgs_per_s"] = round(rate, 4)
                 if rate > best["value"]:
                     best.update(value=rate, variant=f"batched_{g}groups")
                 report()
-        except Exception as e:  # keep the best number so far
+          except Exception as e:  # keep the best number so far
             print(f"batched variant failed ({type(e).__name__}: {e}); "
                   f"reporting {best['variant']}", file=sys.stderr)
 
@@ -298,6 +311,30 @@ def _measure(preset):
         else:
             print(f"dpm secondary skipped: {time_left():.0f}s left",
                   file=sys.stderr)
+
+        # DPM at the best batched operating point (g=8): the highest
+        # practical quality-matched rate the chip reaches. Secondary extras
+        # only — the headline metric stays the spec'd 50-step DDIM workload.
+        # Gated on the single-group DPM secondary having succeeded (it built
+        # controller_dpm and proved the dpm program runs).
+        if "dpm20_imgs_per_s" not in extras or sweep is None:
+            print("dpm batched secondary skipped: prerequisite "
+                  "(single-group dpm / batched imports) did not succeed",
+                  file=sys.stderr)
+        elif time_left() <= 300:
+            print(f"dpm batched secondary skipped: {time_left():.0f}s left",
+                  file=sys.stderr)
+        else:
+            try:
+                g = 8
+                ctrls8 = broadcast_groups(g, controller_dpm)
+                rate = timed(lambda s: run_batched(
+                    g, ctrls8, s, steps=20, scheduler="dpm")) * g * len(prompts)
+                extras["dpm20_batched_8groups_imgs_per_s"] = round(rate, 4)
+                report()
+            except Exception as e:
+                print(f"dpm batched secondary failed "
+                      f"({type(e).__name__}: {e})", file=sys.stderr)
 
     return 0
 

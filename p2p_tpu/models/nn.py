@@ -260,11 +260,14 @@ def fused_attention(q: jax.Array, k: jax.Array, v: jax.Array, scale: float,
 _FLASH_VMEM_BUDGET = 14 * 2**20
 
 
-def flash_block(seq_len: int, head_dim: int = 128, itemsize: int = 2) -> int:
+def flash_block(seq_len: int, head_dim: int, itemsize: int) -> int:
     """Largest power-of-two block that tiles ``seq_len`` (the Pallas kernel
     requires seq_len % block == 0) AND keeps the kernel's scoped-VMEM
     footprint inside the TPU budget for this ``head_dim``/``itemsize``;
-    0 → no viable block (einsum/XLA path instead)."""
+    0 → no viable block (einsum/XLA path instead). The geometry args are
+    deliberately required: a default would make the VMEM guard opt-in, and
+    a wide-head f32 call site (the VAE mid-attention shape) that omitted
+    them would compile-time-OOM scoped VMEM on the chip."""
     for b in (1024, 512, 256):
         if seq_len % b == 0 and b * head_dim * (8 * itemsize + 8) <= _FLASH_VMEM_BUDGET:
             return b

@@ -43,7 +43,7 @@ def _rand_qkv(seed, b, h, s, d, dtype):
 @pytest.mark.slow
 def test_flash_interpret_parity_f32_sd_shape():
     s, d = 4096, 40  # the 64²-pixel SD-1.4 site
-    blk = nn.flash_block(s)
+    blk = nn.flash_block(s, d, 4)
     assert blk == 1024  # the block size the production path selects
     q, k, v = _rand_qkv(0, 1, 2, s, d, jnp.float32)
     scale = 1.0 / np.sqrt(d)
@@ -58,7 +58,7 @@ def test_flash_interpret_parity_f32_sd_shape():
 def test_flash_interpret_parity_bf16_sd_shape():
     # The production dtype on TPU: bf16 tensors, f32 softmax accumulation.
     s, d = 4096, 40
-    blk = nn.flash_block(s)
+    blk = nn.flash_block(s, d, 2)
     q, k, v = _rand_qkv(1, 1, 1, s, d, jnp.bfloat16)
     scale = 1.0 / np.sqrt(d)
     with pltpu.force_tpu_interpret_mode():
@@ -100,11 +100,12 @@ def test_flash_interpret_parity_vae_head_geometry():
 
 
 def test_flash_block_selection():
-    assert nn.flash_block(4096) == 1024
-    assert nn.flash_block(2048) == 1024
-    assert nn.flash_block(1024) == 1024
-    assert nn.flash_block(768) == 256
-    assert nn.flash_block(1000) == 0  # not tileable → einsum path
+    # Tiling-only selection at the narrow SD head geometry (VMEM not binding).
+    assert nn.flash_block(4096, 40, 2) == 1024
+    assert nn.flash_block(2048, 40, 2) == 1024
+    assert nn.flash_block(1024, 40, 2) == 1024
+    assert nn.flash_block(768, 40, 2) == 256
+    assert nn.flash_block(1000, 40, 2) == 0  # not tileable → einsum path
     # Scoped-VMEM-aware selection: the SD U-Net 64² site (bf16, D=40) keeps
     # the largest block; the VAE mid-attention shape (f32, D=512) must step
     # down — block 1024 there is the 19 MiB > 16 MiB compile-time OOM that

@@ -1,17 +1,25 @@
-"""Shared SD14 50-step scan benchmark (currently used by prof_flags.py; the
-other prof_* scripts are frozen records of specific round-2 experiments —
-their inline copies document exactly what was measured then)."""
+"""Shared SD14 50-step scan benchmark, used by prof_flags.py and
+prof_unroll.py. prof_experiments.py keeps its own inline copy because it
+monkeypatches model internals between timings; prof_variants/prof_breakdown/
+prof_gn_flash are frozen records of specific round-2 experiments."""
 import os
 import sys
 import time
+from functools import partial
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
-def sd14_scan_ms_per_step(batch: int = 4, steps: int = 50, repeats: int = 2) -> float:
-    """Best-of-N ms/step for the jitted SD14 U-Net scan (identity controller)."""
+def sd14_scan_ms_per_step(batch: int = 4, steps: int = 50, repeats: int = 2,
+                          compiler_options=None, unroll: int = 1) -> float:
+    """Best-of-N ms/step for the jitted SD14 U-Net scan (identity controller).
+
+    ``compiler_options`` are forwarded to ``jax.jit`` (PJRT passes them to the
+    server-side TPU compiler — the working route for ``xla_tpu_*`` options on
+    the axon platform, where XLA_FLAGS is parsed by a client that doesn't
+    know them). ``unroll`` is forwarded to ``lax.scan``."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -30,12 +38,13 @@ def sd14_scan_ms_per_step(batch: int = 4, steps: int = 50, repeats: int = 2) -> 
     ctx = jnp.ones((batch, cfg.unet.context_len, cfg.unet.context_dim),
                    jnp.bfloat16)
 
-    @jax.jit
+    @partial(jax.jit, compiler_options=compiler_options)
     def scan(params, x, ctx):
         def body(h, t):
             eps, _ = apply_unet(params, cfg.unet, h, t, ctx, layout=layout)
             return eps, None
-        out, _ = jax.lax.scan(body, x, jnp.arange(steps, dtype=jnp.int32))
+        out, _ = jax.lax.scan(body, x, jnp.arange(steps, dtype=jnp.int32),
+                              unroll=unroll)
         return out
 
     np.asarray(scan(params, x, ctx))  # compile

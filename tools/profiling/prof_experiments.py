@@ -1,4 +1,11 @@
-"""Experiments: small-site attention lowering, bf16 VAE, batch scaling."""
+"""On-chip A/B experiments for the SD14 step-time budget.
+
+Default run (round-3 set): baseline scan, gather-vs-broadcast upsample,
+flash head-dim pad probe, batch scaling, VAE decode dtype. The round-2
+small-site attention lowerings (dot_product_attention everywhere, flash down
+to S>=1024) were measured and rejected (+46% step time; PERF.md) — rerun them
+with --all.
+"""
 import os, sys, time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))
@@ -11,6 +18,9 @@ from p2p_tpu.models import SD14, init_unet, unet_layout
 from p2p_tpu.models import vae as vae_mod
 from p2p_tpu.models import nn as nn_mod
 from p2p_tpu.models.unet import apply_unet
+from p2p_tpu.utils.cache import enable_persistent_cache
+
+enable_persistent_cache()
 
 cfg = SD14
 layout = unet_layout(cfg.unet)
@@ -38,49 +48,26 @@ def time_scan(B, label, steps=50):
           flush=True)
     return best / steps
 
-# 1. baseline fused (current: einsum f32 probs for S<2048, flash for 4096)
+orig_fused = nn_mod.fused_attention
+import p2p_tpu.models.unet as unet_mod
+
+# 1. baseline (current code: broadcast+reshape upsample, einsum f32 probs for
+# S<2048, flash for 4096). Same program as _bench_common → warm-cache load.
 t_base = time_scan(4, "baseline")
 
-# 2. dot_product_attention for ALL untouched sites
-orig_fused = nn_mod.fused_attention
-def fused_dpa(q, k, v, scale, mask=None):
-    if mask is None:
-        out = jax.nn.dot_product_attention(
-            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-            v.transpose(0, 2, 1, 3), scale=scale)
-        return out.transpose(0, 2, 1, 3)
-    return orig_fused(q, k, v, scale, mask)
-nn_mod.fused_attention = fused_dpa
-import p2p_tpu.models.unet as unet_mod
-unet_mod.nn.fused_attention = fused_dpa
-t_dpa = time_scan(4, "dot_product_attention all")
+# 2. old gather-based upsample (pre-round-3) vs the landed broadcast+reshape
+# — quantifies the relayout win on-chip.
+orig_up = nn_mod.upsample_nearest_2x
+def upsample_resize(x):
+    b, h, w, c = x.shape
+    return jax.image.resize(x, (b, h * 2, w * 2, c), method="nearest")
+nn_mod.upsample_nearest_2x = upsample_resize
+unet_mod.nn.upsample_nearest_2x = upsample_resize
+time_scan(4, "upsample via image.resize")
+nn_mod.upsample_nearest_2x = orig_up
+unet_mod.nn.upsample_nearest_2x = orig_up
 
-# 3. flash kernel down to S>=1024 (32² sites), dpa below
-from jax.experimental.pallas.ops.tpu import flash_attention as _fa
-def fused_flash1024(q, k, v, scale, mask=None):
-    s_q, s_k = q.shape[-2], k.shape[-2]
-    if mask is None and s_q == s_k and s_q >= 1024:
-        blk = next((b for b in (1024, 512, 256) if s_q % b == 0), 0)
-        if blk:
-            sizes = _fa.BlockSizes(block_q=blk, block_k_major=blk, block_k=blk,
-                block_b=1, block_q_major_dkv=blk, block_k_major_dkv=blk,
-                block_q_dkv=blk, block_k_dkv=blk)
-            return _fa.flash_attention(q, k, v, causal=False, sm_scale=scale,
-                                       block_sizes=sizes)
-    return fused_dpa(q, k, v, scale, mask)
-nn_mod.fused_attention = fused_flash1024
-unet_mod.nn.fused_attention = fused_flash1024
-t_flash = time_scan(4, "flash>=1024 + dpa")
-
-# restore
-nn_mod.fused_attention = orig_fused
-unet_mod.nn.fused_attention = orig_fused
-
-# 4. batch scaling with the best variant so far (baseline for now)
-for B in (8, 16):
-    time_scan(B, "baseline batchscale", steps=25)
-
-# 4b. head_dim pad 40→64 at the flash sites (MXU lane-efficiency probe;
+# 3. head_dim pad 40→64 at the flash sites (MXU lane-efficiency probe;
 # semantically exact: zero-padded q/k leave logits unchanged, padded v dims
 # are sliced off). Theory says XLA/Mosaic pad internally and this is a wash —
 # measure to confirm.
@@ -98,17 +85,9 @@ time_scan(4, "flash head_dim pad64")
 nn_mod.fused_attention = orig_fused
 unet_mod.nn.fused_attention = orig_fused
 
-# 4c. old gather-based upsample (pre-round-3) vs the landed broadcast+reshape
-# — quantifies the relayout win on-chip.
-orig_up = nn_mod.upsample_nearest_2x
-def upsample_resize(x):
-    b, h, w, c = x.shape
-    return jax.image.resize(x, (b, h * 2, w * 2, c), method="nearest")
-nn_mod.upsample_nearest_2x = upsample_resize
-unet_mod.nn.upsample_nearest_2x = upsample_resize
-time_scan(4, "upsample via image.resize")
-nn_mod.upsample_nearest_2x = orig_up
-unet_mod.nn.upsample_nearest_2x = orig_up
+# 4. batch scaling (the bench g-sweep's underlying scan cost).
+for B in (8, 16):
+    time_scan(B, "baseline batchscale", steps=25)
 
 # 5. VAE decode bf16 vs f32
 vparams = vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae)
@@ -118,3 +97,39 @@ for dt, name in ((jnp.float32, "vae f32"), (jnp.bfloat16, "vae bf16")):
     np.asarray(vdec(vparams, lat))
     t0 = time.perf_counter(); np.asarray(vdec(vparams, lat))
     print(f"{name}: {(time.perf_counter()-t0)*1000:.0f} ms", flush=True)
+
+if "--all" not in sys.argv:
+    sys.exit(0)
+
+# --- round-2 record: small-site attention lowerings (rejected; PERF.md) ---
+
+# 6. dot_product_attention for ALL untouched sites
+def fused_dpa(q, k, v, scale, mask=None):
+    if mask is None:
+        out = jax.nn.dot_product_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), scale=scale)
+        return out.transpose(0, 2, 1, 3)
+    return orig_fused(q, k, v, scale, mask)
+nn_mod.fused_attention = fused_dpa
+unet_mod.nn.fused_attention = fused_dpa
+time_scan(4, "dot_product_attention all")
+
+# 7. flash kernel down to S>=1024 (32² sites), dpa below
+from jax.experimental.pallas.ops.tpu import flash_attention as _fa
+def fused_flash1024(q, k, v, scale, mask=None):
+    s_q, s_k = q.shape[-2], k.shape[-2]
+    if mask is None and s_q == s_k and s_q >= 1024:
+        blk = next((b for b in (1024, 512, 256) if s_q % b == 0), 0)
+        if blk:
+            sizes = _fa.BlockSizes(block_q=blk, block_k_major=blk, block_k=blk,
+                block_b=1, block_q_major_dkv=blk, block_k_major_dkv=blk,
+                block_q_dkv=blk, block_k_dkv=blk)
+            return _fa.flash_attention(q, k, v, causal=False, sm_scale=scale,
+                                       block_sizes=sizes)
+    return fused_dpa(q, k, v, scale, mask)
+nn_mod.fused_attention = fused_flash1024
+unet_mod.nn.fused_attention = fused_flash1024
+time_scan(4, "flash>=1024 + dpa")
+nn_mod.fused_attention = orig_fused
+unet_mod.nn.fused_attention = orig_fused

@@ -1,65 +1,84 @@
-"""XLA flag sweep for the SD14 50-step scan + GN/flash validation.
+"""XLA compiler-option sweep for the SD14 50-step scan.
 
-Run when the TPU lease is healthy (each variant re-runs this script in a
-subprocess so XLA_FLAGS take effect at backend init):
+On the axon platform the local client's XLA_FLAGS parser does not know the
+libtpu ``--xla_tpu_*`` flags (the backend compiler runs server-side behind
+the PJRT tunnel) — passing them through the environment is a fatal parse
+error before backend init. The working route is per-program
+``jax.jit(..., compiler_options=...)``, which PJRT forwards to the real TPU
+compiler.
+
+Each variant still runs in a subprocess — not for flag isolation (options
+are per-compile now) but so a wedged lease or hung compile costs one
+TIMEOUT line, not the whole sweep:
 
     python tools/profiling/prof_flags.py            # sweep driver
-    python tools/profiling/prof_flags.py --inner    # one measurement
+    python tools/profiling/prof_flags.py --inner '{"...": "..."}'
 """
+import json
 import os
 import subprocess
 import sys
+import time
 
 VARIANTS = {
-    "baseline": "",
-    "latency_hiding": "--xla_tpu_enable_latency_hiding_scheduler=true",
-    "vmem_128m": "--xla_tpu_scoped_vmem_limit_kib=131072",
-    "async_streams": "--xla_tpu_enable_async_collective_fusion=true",
-    "latency_vmem": ("--xla_tpu_enable_latency_hiding_scheduler=true "
-                     "--xla_tpu_scoped_vmem_limit_kib=131072"),
+    "baseline": {},
+    "latency_hiding": {"xla_tpu_enable_latency_hiding_scheduler": "true"},
+    "vmem_128m": {"xla_tpu_scoped_vmem_limit_kib": "131072"},
+    "vmem_192m": {"xla_tpu_scoped_vmem_limit_kib": "196608"},
+    "latency_vmem128": {"xla_tpu_enable_latency_hiding_scheduler": "true",
+                        "xla_tpu_scoped_vmem_limit_kib": "131072"},
+    "latency_vmem192": {"xla_tpu_enable_latency_hiding_scheduler": "true",
+                        "xla_tpu_scoped_vmem_limit_kib": "196608"},
     # Data-formatting attack (the 11% relayout share in the round-2 trace).
-    # Unknown-flag variants fail at backend init in seconds and are reported
-    # FAILED by the sweep — they never cost real chip time.
-    "sched_features": "--xla_tpu_enable_all_experimental_scheduler_features=true",
-    "vmem_192m": "--xla_tpu_scoped_vmem_limit_kib=196608",
-    "latency_vmem192": ("--xla_tpu_enable_latency_hiding_scheduler=true "
-                        "--xla_tpu_scoped_vmem_limit_kib=196608"),
+    # Unknown options come back as a catchable compile error and are
+    # reported FAILED — they never cost real chip time.
+    "sched_features": {
+        "xla_tpu_enable_all_experimental_scheduler_features": "true"},
+    "latency_sched_vmem192": {
+        "xla_tpu_scoped_vmem_limit_kib": "196608",
+        "xla_tpu_enable_latency_hiding_scheduler": "true",
+        "xla_tpu_enable_all_experimental_scheduler_features": "true"},
 }
 
 
-def inner():
+def inner(opts_json: str):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from _bench_common import sd14_scan_ms_per_step
 
-    print(f"RESULT {sd14_scan_ms_per_step():.2f} ms/step", flush=True)
+    opts = json.loads(opts_json)
+    ms = sd14_scan_ms_per_step(compiler_options=opts or None)
+    print(f"RESULT {ms:.2f}", flush=True)
 
 
 def main():
     if "--inner" in sys.argv:
-        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-        inner()
+        i = sys.argv.index("--inner") + 1
+        inner(sys.argv[i] if i < len(sys.argv) else "{}")
         return
-    for name, flags in VARIANTS.items():
-        env = dict(os.environ)
-        if flags:
-            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + flags).strip()
-        # Per-variant cache isolation: enable_persistent_cache hashes the
-        # variant's XLA_FLAGS into the cache directory name — but only on
-        # its default path, so drop any inherited explicit cache dir.
-        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    results = {}
+    for name, opts in VARIANTS.items():
+        t0 = time.monotonic()
         try:
             out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--inner"],
-                env=env, timeout=900, stdout=subprocess.PIPE,
+                [sys.executable, os.path.abspath(__file__), "--inner",
+                 json.dumps(opts)],
+                timeout=900, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, text=True).stdout
         except subprocess.TimeoutExpired:
-            print(f"{name:16s}: TIMEOUT", flush=True)
+            print(f"{name:22s}: TIMEOUT", flush=True)
             continue
-        line = next((l for l in out.splitlines() if l.startswith("RESULT")), None)
+        line = next((l for l in out.splitlines() if l.startswith("RESULT")),
+                    None)
         if line is None:
             tail = "\n    ".join(out.splitlines()[-5:])
-            print(f"{name:16s}: FAILED —\n    {tail}", flush=True)
+            print(f"{name:22s}: FAILED —\n    {tail}", flush=True)
         else:
-            print(f"{name:16s}: {line}", flush=True)
+            results[name] = float(line.split()[1])
+            print(f"{name:22s}: {results[name]:.2f} ms/step "
+                  f"(wall {time.monotonic() - t0:.0f}s)", flush=True)
+    if results:
+        best = min(results, key=results.get)
+        print(f"BEST {best}: {results[best]:.2f} ms/step", flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,15 @@
+"""Scan-unroll probe: does unrolling the 50-step denoise loop help the
+server-side scheduler overlap work across steps? Steps are sequentially
+dependent, so gains would come from loop-overhead removal and cross-step
+fusion of the scheduler math, not real overlap.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _bench_common import sd14_scan_ms_per_step
+
+for unroll in (1, 2, 5):
+    ms = sd14_scan_ms_per_step(unroll=unroll)
+    print(f"unroll={unroll}: {ms:7.2f} ms/step", flush=True)
