@@ -336,6 +336,39 @@ def _measure(preset):
                 print(f"dpm batched secondary failed "
                       f"({type(e).__name__}: {e})", file=sys.stderr)
 
+        # Null-text inversion wallclock (BASELINE.json config 4 and part of
+        # its metric line; `/root/reference/null_text.py:608-618` workload:
+        # 50 DDIM inversion steps + per-step uncond optimization, ≤10 inner
+        # Adam steps, reference lr/early-stop). One timed pass after the
+        # compile pass — a wallclock metric, not a throughput sweep. Runs
+        # last: its two fresh programs are the most expensive compile in the
+        # bench, and a timeout kill here can no longer lose earlier extras.
+        if time_left() > 900:
+            try:
+                from p2p_tpu.engine.inversion import invert
+
+                side = cfg.image_size
+                img_in = np.random.RandomState(0).randint(
+                    0, 256, (side, side, 3)).astype(np.uint8)
+
+                def run_invert():
+                    art = invert(pipe, img_in, prompts[0],
+                                 num_steps=num_steps, dtype=dtype)
+                    return np.asarray(art.uncond_embeddings)
+
+                run_invert()  # compile (ddim-invert + null-optimize programs)
+                t1 = time.perf_counter()
+                run_invert()
+                extras["nullinv_s_per_image"] = round(
+                    time.perf_counter() - t1, 2)
+                report()
+            except Exception as e:
+                print(f"null-inversion secondary failed "
+                      f"({type(e).__name__}: {e})", file=sys.stderr)
+        else:
+            print(f"null-inversion secondary skipped: {time_left():.0f}s left",
+                  file=sys.stderr)
+
     return 0
 
 

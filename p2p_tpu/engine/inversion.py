@@ -137,8 +137,17 @@ def _null_optimize_jit(unet_params, cfg: PipelineConfig,
                        epsilon: jax.Array,
                        progress: bool = False):
     """Per-timestep uncond-embedding optimization
-    (`/root/reference/null_text.py:574-606`). Returns (T, 1, L, D)."""
+    (`/root/reference/null_text.py:574-606`). Returns (T, 1, L, D) f32.
+
+    The optimized embedding and its Adam state live in f32 whatever the
+    model compute dtype (the reference optimizes a f32 torch tensor); the
+    embedding is cast to the model dtype at each U-Net application. This is
+    also what keeps the while_loop carry well-typed on the bf16 TPU path —
+    Adam's f32 scalar schedule would otherwise promote the update and break
+    the carry contract."""
     t_count = schedule.timesteps.shape[0]
+    model_dtype = cond.dtype
+    uncond0 = uncond0.astype(jnp.float32)
 
     def outer(carry, scan_in):
         latent_cur, uncond = carry
@@ -156,13 +165,22 @@ def _null_optimize_jit(unet_params, cfg: PipelineConfig,
             latents, t_count - 1 - i, axis=0, keepdims=False)
         eps_cond, _ = apply_unet(unet_params, cfg.unet, latent_cur, t, cond)
         eps_cond = jax.lax.stop_gradient(eps_cond)
+        # The loss's step math and compare run in f32 whatever the model
+        # dtype (only the U-Net forwards stay in model dtype): on the bf16
+        # path a bf16 (prev - target) would bottom out at ~1e-5 quantization
+        # noise — the same magnitude as early_stop_epsilon, turning the
+        # early-stop into a coin flip. ddim_step computes in f32 internally
+        # and casts to its sample's dtype, so feed it the f32 latent.
+        latent_f = latent_cur.astype(jnp.float32)
+        target_f = target.astype(jnp.float32)
 
         def loss_fn(u):
-            eps_u, _ = apply_unet(unet_params, cfg.unet, latent_cur, t, u)
+            eps_u, _ = apply_unet(unet_params, cfg.unet, latent_cur, t,
+                                  u.astype(model_dtype))
             eps = eps_u + guidance_scale * (eps_cond - eps_u)
             eps = sched_mod.to_epsilon(schedule, eps, t, latent_cur)
-            prev = sched_mod.ddim_step(schedule, eps, t, latent_cur)
-            return jnp.mean((prev - target) ** 2)
+            prev = sched_mod.ddim_step(schedule, eps, t, latent_f)
+            return jnp.mean(jnp.square(prev - target_f))
 
         def inner_cond(state):
             _, _, _, j, loss = state
@@ -183,7 +201,8 @@ def _null_optimize_jit(unet_params, cfg: PipelineConfig,
 
         # Advance with the optimized uncond under full CFG
         # (`/root/reference/null_text.py:602-604`).
-        eps_u, _ = apply_unet(unet_params, cfg.unet, latent_cur, t, u_opt)
+        eps_u, _ = apply_unet(unet_params, cfg.unet, latent_cur, t,
+                              u_opt.astype(model_dtype))
         eps = eps_u + guidance_scale * (eps_cond - eps_u)
         eps = sched_mod.to_epsilon(schedule, eps, t, latent_cur)
         latent_next = sched_mod.ddim_step(schedule, eps, t, latent_cur)

@@ -127,8 +127,12 @@ def _denoise_scan(
         ctx = context
         if uncond_per_step is not None:
             # Null-text: substitute this step's optimized uncond embedding.
+            # Cast to the sampling dtype — the artifact stores f32 (the
+            # optimizer's dtype), and a f32 leak here would silently promote
+            # the whole CFG context (and the U-Net matmuls) on the bf16 path.
             u = jax.lax.dynamic_index_in_dim(uncond_per_step, step, 0, keepdims=False)
-            ctx = jnp.concatenate([jnp.broadcast_to(u, context[:b].shape),
+            ctx = jnp.concatenate([jnp.broadcast_to(u.astype(context.dtype),
+                                                    context[:b].shape),
                                    context[b:]], axis=0)
         latent_in = jnp.concatenate([latents] * 2, axis=0)
         eps, state = apply_unet(

@@ -80,6 +80,22 @@ def test_optimized_uncond_beats_raw_uncond(artifact, tiny_pipe):
     assert err_opt <= err_raw * 1.05, (err_opt, err_raw)
 
 
+def test_invert_bf16_smoke(tiny_pipe):
+    """The on-chip bench times invert() in bf16 (the TPU production dtype);
+    pin that the bf16 path runs end-to-end and produces finite, sane-shaped
+    outputs (accuracy is pinned by the f32 tests + torch parity)."""
+    rng = np.random.default_rng(1)
+    image = rng.integers(0, 256, (TINY.image_size, TINY.image_size, 3),
+                         dtype=np.uint8)
+    art = invert(tiny_pipe, image, "a cat riding a bike", num_steps=2,
+                 num_inner_steps=2, dtype=jnp.bfloat16)
+    assert art.uncond_embeddings.shape == (
+        2, 1, TINY.text.max_length, TINY.text.hidden_dim)
+    assert np.isfinite(np.asarray(art.uncond_embeddings,
+                                  dtype=np.float32)).all()
+    assert art.image_rec.dtype == np.uint8
+
+
 def test_load_image_crop(tmp_path):
     from PIL import Image
 

@@ -130,6 +130,36 @@ def test_dp_sweep_matches_sequential(tiny_pipe, devices):
     assert not np.array_equal(np.asarray(imgs[0][1]), np.asarray(imgs[3][1]))
 
 
+def test_sweep_dpm_scheduler_matches_text2image(tiny_pipe):
+    """sweep(scheduler="dpm") — the program bench.py's DPM batched secondary
+    times — must match the single-group text2image DPM path on the same
+    latent and controller."""
+    from p2p_tpu.engine.sampler import text2image
+
+    cfg = TINY
+    tok = tiny_pipe.tokenizer
+    prompts = ["a cat riding a bike", "a dog riding a bike"]
+    steps = 3
+    ctrl = factory.attention_replace(
+        prompts, steps, cross_replace_steps=0.8, self_replace_steps=0.4,
+        tokenizer=tok, self_max_pixels=64, max_len=cfg.text.max_length)
+
+    base = jax.random.normal(jax.random.PRNGKey(5),
+                             (1,) + tiny_pipe.latent_shape, jnp.float32)
+    want, _, _ = text2image(tiny_pipe, prompts, ctrl, num_steps=steps,
+                            scheduler="dpm", latent=base)
+
+    ctx_c = encode_prompts(tiny_pipe, prompts)
+    ctx_u = encode_prompts(tiny_pipe, [""] * 2)
+    ctx = jnp.concatenate([ctx_u, ctx_c], axis=0)[None]
+    lats = jnp.broadcast_to(base, (1, 2) + tiny_pipe.latent_shape)
+    ctrls = jax.tree_util.tree_map(lambda x: x[None], ctrl)
+    got, _ = sweep(tiny_pipe, ctx, lats, ctrls, num_steps=steps,
+                   scheduler="dpm", mesh=None)
+    np.testing.assert_allclose(np.asarray(got[0], np.float32),
+                               np.asarray(want, np.float32), atol=1.0)
+
+
 def test_multihost_helpers_single_process(devices):
     """Single-process degradation: initialize() is a no-op, global_mesh
     covers the local devices, process_groups spans everything."""
