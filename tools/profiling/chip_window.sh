@@ -1,0 +1,30 @@
+#!/bin/bash
+# Priority-ordered chip-window runner: the axon relay's healthy windows are
+# scarce (two multi-hour outages in two days), so when it recovers, run the
+# highest-value jobs first, each with its own leash. The trace capture is
+# deliberately NOT here — stopping a trace can wedge the lease; run
+# prof_trace.py manually, last, when nothing else is pending.
+#
+#   tools/profiling/chip_window.sh [logdir]      # run now
+#
+set -u
+cd "$(dirname "$0")/../.."
+L="${1:-/tmp/chipwindow}"
+mkdir -p "$L"
+
+run() { # name timeout cmd...
+  local name="$1" leash="$2"; shift 2
+  echo "=== $name (leash ${leash}s) $(date -u +%H:%M:%S)" | tee -a "$L/runner.log"
+  timeout "$leash" "$@" > "$L/$name.log" 2>&1
+  local rc=$?
+  echo "=== $name rc=$rc $(date -u +%H:%M:%S)" | tee -a "$L/runner.log"
+}
+
+# 1. The driver metric + cache priming for every program bench now times
+#    (incl. the dpm-batched and null-inversion secondaries).
+run bench 1800 python bench.py
+# 2. A/B experiments: upsample, head-dim pad, batch scaling, VAE dtype.
+run experiments 1500 python tools/profiling/prof_experiments.py
+# 3. Scan unroll probe.
+run unroll 1200 python tools/profiling/prof_unroll.py
+echo "window done; logs in $L" | tee -a "$L/runner.log"
