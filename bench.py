@@ -189,9 +189,11 @@ def _measure(preset):
     from p2p_tpu.utils.tokenizer import HashWordTokenizer
 
     t0 = time.monotonic()
-    # Rehearsal disables the budget gates: every block must actually run.
-    budget = float(os.environ.get(
-        "P2P_BENCH_BUDGET_S", "1e9" if preset == "rehearse" else "1800"))
+    # Rehearsal disables the budget gates unconditionally (an inherited
+    # P2P_BENCH_BUDGET_S must not silently re-enable skips): every block
+    # must actually run.
+    budget = (1e9 if preset == "rehearse"
+              else float(os.environ.get("P2P_BENCH_BUDGET_S", "1800")))
 
     def time_left():
         return budget - (time.monotonic() - t0)
@@ -333,147 +335,128 @@ def _measure(preset):
             note(f"batched variant failed ({type(e).__name__}: {e}); "
                  f"reporting {best['variant']}")
 
+        def secondary(name, fn, min_left=300, needs_sweep=False,
+                      prereq=True, prereq_msg=""):
+            """One budget-gated, failure-isolated secondary measurement.
+
+            Skip causes report distinctly (missing batched imports vs failed
+            prerequisite vs time budget), and every skip or failure goes
+            through note() so it fails the rehearsal."""
+            if needs_sweep and sweep is None:
+                note(f"{name} skipped: batched imports unavailable")
+            elif not prereq:
+                note(f"{name} skipped: {prereq_msg}")
+            elif time_left() <= min_left:
+                note(f"{name} skipped: {time_left():.0f}s left")
+            else:
+                try:
+                    fn()
+                    report()
+                except Exception as e:
+                    note(f"{name} failed ({type(e).__name__}: {e})")
+
         # Quality-matched secondary: DPM-Solver++(2M) at 20 steps reaches
         # ~50-step-DDIM quality (PERF.md) — the practical operating point.
-        if time_left() > 300:
-            try:
-                def run_dpm(seed):
-                    img, _, _ = text2image(
-                        pipe, prompts, controller_dpm, num_steps=20,
-                        scheduler="dpm", rng=jax.random.PRNGKey(seed),
-                        dtype=dtype)
-                    return np.asarray(img)
+        dpm_ctrl = {}
 
-                controller_dpm = factory.attention_replace(
-                    prompts, 20, cross_replace_steps=0.8,
-                    self_replace_steps=0.4, tokenizer=tok,
-                    self_max_pixels=self_px, max_len=cfg.text.max_length)
-                extras["dpm20_imgs_per_s"] = round(
-                    timed(run_dpm) * len(prompts), 4)
-                report()
-            except Exception as e:
-                note(f"dpm secondary failed ({type(e).__name__}: {e})")
-        else:
-            note(f"dpm secondary skipped: {time_left():.0f}s left")
+        def dpm_single():
+            ctrl = factory.attention_replace(
+                prompts, 20, cross_replace_steps=0.8,
+                self_replace_steps=0.4, tokenizer=tok,
+                self_max_pixels=self_px, max_len=cfg.text.max_length)
+
+            def run_dpm(seed):
+                img, _, _ = text2image(
+                    pipe, prompts, ctrl, num_steps=20, scheduler="dpm",
+                    rng=jax.random.PRNGKey(seed), dtype=dtype)
+                return np.asarray(img)
+
+            extras["dpm20_imgs_per_s"] = round(timed(run_dpm) * len(prompts), 4)
+            dpm_ctrl["ctrl"] = ctrl
 
         # DPM at the best batched operating point (g=8): the highest
         # practical quality-matched rate the chip reaches. Secondary extras
         # only — the headline metric stays the spec'd 50-step DDIM workload.
-        # Gated on the single-group DPM secondary having succeeded (it built
-        # controller_dpm and proved the dpm program runs).
-        if "dpm20_imgs_per_s" not in extras or sweep is None:
-            note("dpm batched secondary skipped: prerequisite "
-                 "(single-group dpm / batched imports) did not succeed")
-        elif time_left() <= 300:
-            note(f"dpm batched secondary skipped: {time_left():.0f}s left")
-        else:
-            try:
-                g = 8
-                ctrls8 = broadcast_groups(g, controller_dpm)
-                rate = timed(lambda s: run_batched(
-                    g, ctrls8, s, steps=20, scheduler="dpm")) * g * len(prompts)
-                extras["dpm20_batched_8groups_imgs_per_s"] = round(rate, 4)
-                report()
-            except Exception as e:
-                note(f"dpm batched secondary failed "
-                     f"({type(e).__name__}: {e})")
+        def dpm_batched():
+            g = 8
+            ctrls8 = broadcast_groups(g, dpm_ctrl["ctrl"])
+            rate = timed(lambda s: run_batched(
+                g, ctrls8, s, steps=20, scheduler="dpm")) * g * len(prompts)
+            extras["dpm20_batched_8groups_imgs_per_s"] = round(rate, 4)
 
         # BASELINE config 3: AttentionReweight equalizer sweep — 4 groups
         # with per-group equalizer scales riding ONE compiled program (the
         # scales are traced leaves; `/root/reference/main.py:281-290` is a
         # batch on one device, here it's the dp sweep engine).
-        if sweep is not None and time_left() > 300:
-            try:
-                from p2p_tpu.align.words import get_equalizer
+        def reweight_eqsweep():
+            from p2p_tpu.align.words import get_equalizer
 
-                rw_prompts = [prompts[0], prompts[0]]
-                rw_list = []
-                for scale in (0.5, 1.0, 2.0, 4.0):
-                    eq = get_equalizer(rw_prompts[1], ("burger",), (scale,),
-                                       tok)
-                    rw_list.append(factory.attention_reweight(
-                        rw_prompts, num_steps, cross_replace_steps=0.8,
-                        self_replace_steps=0.4, equalizer=eq, tokenizer=tok,
-                        self_max_pixels=self_px,
-                        max_len=cfg.text.max_length))
-                rw_ctrls = jax.tree_util.tree_map(
-                    lambda *xs: jnp.stack(xs), *rw_list)
-                g = 4
-                rate = timed(lambda s: run_batched(
-                    g, rw_ctrls, s, bprompts=rw_prompts)) * g * len(rw_prompts)
-                extras["reweight_eqsweep_4groups_imgs_per_s"] = round(rate, 4)
-                report()
-            except Exception as e:
-                note(f"reweight sweep secondary failed "
-                     f"({type(e).__name__}: {e})")
-        else:
-            note(f"reweight sweep secondary skipped: "
-                 f"{time_left():.0f}s left")
+            rw_prompts = [prompts[0], prompts[0]]
+            rw_list = []
+            for scale in (0.5, 1.0, 2.0, 4.0):
+                eq = get_equalizer(rw_prompts[1], ("burger",), (scale,), tok)
+                rw_list.append(factory.attention_reweight(
+                    rw_prompts, num_steps, cross_replace_steps=0.8,
+                    self_replace_steps=0.4, equalizer=eq, tokenizer=tok,
+                    self_max_pixels=self_px, max_len=cfg.text.max_length))
+            rw_ctrls = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *rw_list)
+            g = 4
+            rate = timed(lambda s: run_batched(
+                g, rw_ctrls, s, bprompts=rw_prompts)) * g * len(rw_prompts)
+            extras["reweight_eqsweep_4groups_imgs_per_s"] = round(rate, 4)
 
         # BASELINE config 2: AttentionRefine + LocalBlend, 2 prompts, 50
         # steps. A different controller structure (NW gather + blend step
         # callback reading the store) → a distinct XLA program from the
         # headline Replace edit.
-        if time_left() > 300:
-            try:
-                rb_prompts = ["a squirrel eating a burger",
-                              "a squirrel eating a tasty burger"]
-                blend = factory.local_blend(
-                    rb_prompts, ("burger", "burger"), tok, start_blend=0.2,
-                    num_steps=num_steps, resolution=blend_res,
-                    max_len=cfg.text.max_length)
-                ctrl_rb = factory.attention_refine(
-                    rb_prompts, num_steps, cross_replace_steps=0.8,
-                    self_replace_steps=0.4, tokenizer=tok, local_blend=blend,
-                    self_max_pixels=self_px, max_len=cfg.text.max_length)
+        def refine_localblend():
+            rb_prompts = ["a squirrel eating a burger",
+                          "a squirrel eating a tasty burger"]
+            blend = factory.local_blend(
+                rb_prompts, ("burger", "burger"), tok, start_blend=0.2,
+                num_steps=num_steps, resolution=blend_res,
+                max_len=cfg.text.max_length)
+            ctrl_rb = factory.attention_refine(
+                rb_prompts, num_steps, cross_replace_steps=0.8,
+                self_replace_steps=0.4, tokenizer=tok, local_blend=blend,
+                self_max_pixels=self_px, max_len=cfg.text.max_length)
 
-                def run_rb(seed):
-                    img, _, _ = text2image(
-                        pipe, rb_prompts, ctrl_rb, num_steps=num_steps,
-                        rng=jax.random.PRNGKey(seed), dtype=dtype)
-                    return np.asarray(img)
+            def run_rb(seed):
+                img, _, _ = text2image(
+                    pipe, rb_prompts, ctrl_rb, num_steps=num_steps,
+                    rng=jax.random.PRNGKey(seed), dtype=dtype)
+                return np.asarray(img)
 
-                extras["refine_localblend_imgs_per_s"] = round(
-                    timed(run_rb) * len(rb_prompts), 4)
-                report()
-            except Exception as e:
-                note(f"refine+blend secondary failed "
-                     f"({type(e).__name__}: {e})")
-        else:
-            note(f"refine+blend secondary skipped: {time_left():.0f}s left")
+            extras["refine_localblend_imgs_per_s"] = round(
+                timed(run_rb) * len(rb_prompts), 4)
 
         # BASELINE config 5: the LDM-256 backend (BERT-style text tower, VQ
         # decode, β 0.0015..0.0195), 8-prompt batch = 4 edit groups of 2
         # through the dp sweep engine.
-        if sweep is not None and time_left() > 300:
-            try:
-                from p2p_tpu.models.config import LDM256, TINY_LDM
+        def ldm256_batch():
+            from p2p_tpu.models.config import LDM256, TINY_LDM
 
-                ldm_cfg = LDM256 if full else TINY_LDM
-                ltok = HashWordTokenizer(
-                    model_max_length=ldm_cfg.text.max_length, sequential=True)
-                lpipe = Pipeline(
-                    config=ldm_cfg,
-                    unet_params=init_unet(jax.random.PRNGKey(10), ldm_cfg.unet),
-                    text_params=init_text_encoder(jax.random.PRNGKey(11),
-                                                  ldm_cfg.text),
-                    vae_params=vae_mod.init_vae(jax.random.PRNGKey(12),
-                                                ldm_cfg.vae),
-                    tokenizer=ltok)
-                lctrl = factory.attention_replace(
-                    prompts, num_steps, cross_replace_steps=0.8,
-                    self_replace_steps=0.4, tokenizer=ltok,
-                    self_max_pixels=self_px, max_len=ldm_cfg.text.max_length)
-                g = 4
-                lctrls = broadcast_groups(g, lctrl)
-                rate = timed(lambda s: run_batched(
-                    g, lctrls, s, bpipe=lpipe)) * g * len(prompts)
-                extras["ldm256_8prompt_imgs_per_s"] = round(rate, 4)
-                report()
-            except Exception as e:
-                note(f"ldm256 secondary failed ({type(e).__name__}: {e})")
-        else:
-            note(f"ldm256 secondary skipped: {time_left():.0f}s left")
+            ldm_cfg = LDM256 if full else TINY_LDM
+            ltok = HashWordTokenizer(
+                model_max_length=ldm_cfg.text.max_length, sequential=True)
+            lpipe = Pipeline(
+                config=ldm_cfg,
+                unet_params=init_unet(jax.random.PRNGKey(10), ldm_cfg.unet),
+                text_params=init_text_encoder(jax.random.PRNGKey(11),
+                                              ldm_cfg.text),
+                vae_params=vae_mod.init_vae(jax.random.PRNGKey(12),
+                                            ldm_cfg.vae),
+                tokenizer=ltok)
+            lctrl = factory.attention_replace(
+                prompts, num_steps, cross_replace_steps=0.8,
+                self_replace_steps=0.4, tokenizer=ltok,
+                self_max_pixels=self_px, max_len=ldm_cfg.text.max_length)
+            g = 4
+            lctrls = broadcast_groups(g, lctrl)
+            rate = timed(lambda s: run_batched(
+                g, lctrls, s, bpipe=lpipe)) * g * len(prompts)
+            extras["ldm256_8prompt_imgs_per_s"] = round(rate, 4)
 
         # Null-text inversion wallclock (BASELINE.json config 4 and part of
         # its metric line; `/root/reference/null_text.py:608-618` workload:
@@ -482,31 +465,32 @@ def _measure(preset):
         # compile pass — a wallclock metric, not a throughput sweep. Runs
         # last: its two fresh programs are the most expensive compile in the
         # bench, and a timeout kill here can no longer lose earlier extras.
-        if time_left() > 900:
-            try:
-                from p2p_tpu.engine.inversion import invert
+        def null_inversion():
+            from p2p_tpu.engine.inversion import invert
 
-                side = cfg.image_size
-                img_in = np.random.RandomState(0).randint(
-                    0, 256, (side, side, 3)).astype(np.uint8)
+            side = cfg.image_size
+            img_in = np.random.RandomState(0).randint(
+                0, 256, (side, side, 3)).astype(np.uint8)
 
-                def run_invert():
-                    art = invert(pipe, img_in, prompts[0],
-                                 num_steps=num_steps, dtype=dtype)
-                    return np.asarray(art.uncond_embeddings)
+            def run_invert():
+                art = invert(pipe, img_in, prompts[0],
+                             num_steps=num_steps, dtype=dtype)
+                return np.asarray(art.uncond_embeddings)
 
-                run_invert()  # compile (ddim-invert + null-optimize programs)
-                t1 = time.perf_counter()
-                run_invert()
-                extras["nullinv_s_per_image"] = round(
-                    time.perf_counter() - t1, 2)
-                report()
-            except Exception as e:
-                note(f"null-inversion secondary failed "
-                     f"({type(e).__name__}: {e})")
-        else:
-            note(f"null-inversion secondary skipped: "
-                 f"{time_left():.0f}s left")
+            run_invert()  # compile (ddim-invert + null-optimize programs)
+            t1 = time.perf_counter()
+            run_invert()
+            extras["nullinv_s_per_image"] = round(time.perf_counter() - t1, 2)
+
+        secondary("dpm secondary", dpm_single)
+        secondary("dpm batched secondary", dpm_batched, needs_sweep=True,
+                  prereq="ctrl" in dpm_ctrl,
+                  prereq_msg="single-group dpm did not succeed")
+        secondary("reweight sweep secondary", reweight_eqsweep,
+                  needs_sweep=True)
+        secondary("refine+blend secondary", refine_localblend)
+        secondary("ldm256 secondary", ldm256_batch, needs_sweep=True)
+        secondary("null-inversion secondary", null_inversion, min_left=900)
 
     if preset == "rehearse" and problems:
         print(f"REHEARSAL INCOMPLETE ({len(problems)} block(s)): "
