@@ -118,6 +118,48 @@ def cmd_generate(args) -> int:
     return 0
 
 
+def _edit_batched(args, pipe, prompts, controller, out_dir) -> int:
+    """The seed sweep as two compiled programs total (baseline + edit), all
+    seeds riding the group axis of the dp sweep engine — the reference's
+    sequential per-seed loop (`/root/reference/main.py:417-444`) at sweep
+    throughput. Shards over a dp mesh when several devices are visible and
+    the seed count divides them."""
+    import jax
+    import jax.numpy as jnp
+
+    from .engine.sampler import encode_prompts
+    from .parallel import make_mesh, sweep
+
+    g = len(args.seeds)
+    cond = encode_prompts(pipe, prompts)
+    uncond = encode_prompts(pipe, [args.negative_prompt or ""] * len(prompts))
+    ctx = jnp.concatenate([uncond, cond], axis=0)
+    ctx = jnp.broadcast_to(ctx[None], (g,) + ctx.shape)
+    # One base latent per seed, shared across the group's prompts (the
+    # shared-seed expansion of `/root/reference/ptp_utils.py:88-95`).
+    base = jnp.stack([jax.random.normal(jax.random.PRNGKey(s),
+                                        (1,) + pipe.latent_shape)
+                      for s in args.seeds])
+    lats = jnp.broadcast_to(base, (g, len(prompts)) + pipe.latent_shape)
+
+    # Shard over up to min(g, n_dev) devices (a 4-seed sweep on an 8-device
+    # slice still rides 4 devices — same gate as examples/equalizer_sweep.py).
+    n_dev = min(len(jax.devices()), g)
+    mesh = (make_mesh(n_dev) if n_dev > 1 and g % n_dev == 0 else None)
+    kw = dict(num_steps=args.steps, guidance_scale=args.guidance,
+              scheduler=args.scheduler, mesh=mesh)
+    base_imgs, _ = sweep(pipe, ctx, lats, None, **kw)
+    ctrls = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (g,) + x.shape), controller)
+    edit_imgs, _ = sweep(pipe, ctx, lats, ctrls, **kw)
+    for i, seed in enumerate(args.seeds):
+        _save(np.asarray(base_imgs[i][0]),
+              os.path.join(out_dir, f"{seed:05d}_y.jpg"))
+        _save(np.asarray(edit_imgs[i][1]),
+              os.path.join(out_dir, f"{seed:05d}_y_hat.jpg"))
+    return 0
+
+
 def cmd_edit(args) -> int:
     import jax
 
@@ -129,6 +171,9 @@ def cmd_edit(args) -> int:
     prompts = [args.source, args.target]
     controller = _make_controller(args, prompts, pipe.tokenizer, args.steps)
     out_dir = args.out_dir or os.path.join("logs", time.strftime("%y%m%d_%H%M%S"))
+    if args.batch_seeds:
+        with trace(args.profile):
+            return _edit_batched(args, pipe, prompts, controller, out_dir)
     with trace(args.profile):
         for seed in args.seeds:
             rng = jax.random.PRNGKey(seed)
@@ -265,6 +310,11 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--source", required=True, help="source prompt")
     e.add_argument("--target", required=True, help="edited prompt")
     e.add_argument("--out-dir", default=None)
+    e.add_argument("--batch-seeds", action="store_true",
+                   help="run the whole seed sweep as batched edit groups "
+                        "through the dp sweep engine (two compiled programs "
+                        "total instead of two per seed; sharded over the "
+                        "mesh when more than one device is visible)")
     e.set_defaults(fn=cmd_edit)
 
     # Inversion is DDIM by construction (`/root/reference/null_text.py:23`);

@@ -32,6 +32,29 @@ def test_edit_writes_pairs(tmp_path):
     assert os.path.exists(os.path.join(out_dir, "00007_y_hat.jpg"))
 
 
+def test_edit_batch_seeds_matches_sequential(tmp_path):
+    """--batch-seeds runs the sweep engine (two programs total); its y/y_hat
+    pairs must match the sequential per-seed loop on the same seeds (both
+    draw the base latent as normal(PRNGKey(seed)))."""
+    from PIL import Image
+
+    seq_dir = os.path.join(tmp_path, "seq")
+    bat_dir = os.path.join(tmp_path, "bat")
+    common = ["edit", "--quiet", "--source", "a cat riding a bike",
+              "--target", "a dog riding a bike", "--mode", "replace",
+              "--steps", "2", "--seeds", "3,9"]
+    assert main(common + ["--out-dir", seq_dir]) == 0
+    assert main(common + ["--batch-seeds", "--out-dir", bat_dir]) == 0
+    for seed in (3, 9):
+        for kind in ("y", "y_hat"):
+            a = np.asarray(Image.open(
+                os.path.join(seq_dir, f"{seed:05d}_{kind}.jpg")), np.float32)
+            b = np.asarray(Image.open(
+                os.path.join(bat_dir, f"{seed:05d}_{kind}.jpg")), np.float32)
+            # Same math modulo vmap reassociation and one JPEG round trip.
+            assert np.abs(a - b).mean() < 3.0, f"seed {seed} {kind} diverged"
+
+
 def test_invert_then_replay(tmp_path):
     from PIL import Image
 
