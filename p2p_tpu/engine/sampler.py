@@ -25,10 +25,13 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Any, Optional, Tuple
+from typing import TYPE_CHECKING, Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+if TYPE_CHECKING:  # the sp plan type; runtime stays import-cycle-free
+    from ..models.unet import SpConfig
 
 from ..controllers.base import (
     AttnLayout,
@@ -103,6 +106,7 @@ def _denoise_scan(
     guidance_scale: jax.Array,
     uncond_per_step: Optional[jax.Array] = None,  # (T, 1, L, D) null-text embeddings
     progress: bool = False,
+    sp: Optional["SpConfig"] = None,
 ) -> Tuple[jax.Array, StoreState]:
     """Scan over timesteps. Returns (final latents, final store state)."""
     b = latents.shape[0]
@@ -137,7 +141,8 @@ def _denoise_scan(
         latent_in = jnp.concatenate([latents] * 2, axis=0)
         eps, state = apply_unet(
             unet_params, cfg.unet, latent_in, t, ctx,
-            layout=layout, controller=controller, state=state, step=step)
+            layout=layout, controller=controller, state=state, step=step,
+            sp=sp)
         eps_uncond, eps_text = eps[:b], eps[b:]
         eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
         # v-prediction models (SD-2.1 768-v): convert to ε once per step.
@@ -159,7 +164,7 @@ def _denoise_scan(
 
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
-                                   "return_store", "progress"))
+                                   "return_store", "progress", "sp"))
 def _text2image_jit(
     unet_params: Any,
     vae_params: Any,
@@ -175,11 +180,12 @@ def _text2image_jit(
     uncond_per_step: Optional[jax.Array],
     return_store: bool,
     progress: bool = False,
+    sp: Optional["SpConfig"] = None,
 ):
     context = jnp.concatenate([context_uncond, context_cond], axis=0)
     latents, state = _denoise_scan(
         unet_params, cfg, layout, schedule, scheduler_kind, context, latents,
-        controller, guidance_scale, uncond_per_step, progress=progress)
+        controller, guidance_scale, uncond_per_step, progress=progress, sp=sp)
     image = vae_mod.decode(vae_params, cfg.vae, latents.astype(jnp.float32))
     image = vae_mod.to_uint8(image)
     return (image, latents, state) if return_store else (image, latents, ())
@@ -201,6 +207,7 @@ def text2image(
     dtype=jnp.float32,
     return_store: bool = False,
     progress: bool = False,
+    sp: Optional["SpConfig"] = None,
 ):
     """Generate an edit group of images from prompts under attention control —
     the `/root/reference/ptp_utils.py:129-172` entry point.
@@ -210,7 +217,11 @@ def text2image(
     all steps. ``negative_prompt`` replaces the default ``""`` unconditional
     text (classifier-free guidance then steers *away* from it — a diffusers
     capability the reference lacks); mutually exclusive with
-    ``uncond_embeddings``. Returns ``(images uint8 (B,H,W,3), x_T, store)``.
+    ``uncond_embeddings``. ``sp`` (a :class:`p2p_tpu.models.unet.SpConfig`)
+    shards the pixel axis of large untouched self-attention sites over a
+    mesh axis with ring attention — the long-context scaling axis (image
+    resolution; SURVEY §5) the reference lacks entirely. Returns
+    ``(images uint8 (B,H,W,3), x_T, store)``.
     """
     if negative_prompt and uncond_embeddings is not None:
         raise ValueError("negative_prompt and uncond_embeddings are mutually "
@@ -254,5 +265,5 @@ def text2image(
     image, latents_out, state = _text2image_jit(
         pipe.unet_params, pipe.vae_params, cfg, layout, schedule, scheduler,
         context_cond, context_uncond, latents, controller, gs,
-        uncond_embeddings, return_store, progress=progress)
+        uncond_embeddings, return_store, progress=progress, sp=sp)
     return image, x_t, state

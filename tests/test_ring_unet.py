@@ -88,6 +88,33 @@ def test_ring_unet_with_controller_keeps_edited_sites_local(sp_mesh):
     np.testing.assert_allclose(fwd(sp), fwd(None), atol=2e-5, rtol=1e-4)
 
 
+def test_text2image_with_sp_matches_unsharded(sp_mesh, tiny_pipe):
+    """The full sampling engine with sp= (ring attention at the 16²-pixel
+    self sites, 8-way) must reproduce the unsharded text2image images —
+    the end-to-end long-context path, not just a single U-Net forward."""
+    from p2p_tpu.controllers import factory
+    from p2p_tpu.engine.sampler import text2image
+
+    tok = tiny_pipe.tokenizer
+    prompts = ["a cat riding a bike", "a dog riding a bike"]
+    steps = 2
+    # store=False: with the default store, every TINY self site (256 px,
+    # under the 32² store cap) is controller-touched and the sp branch
+    # would never compile — the test would compare identical programs.
+    ctrl = factory.attention_replace(
+        prompts, steps, cross_replace_steps=0.8, self_replace_steps=0.4,
+        tokenizer=tok, self_max_pixels=8 * 8,
+        max_len=TINY.text.max_length, store=False)
+    rng = jax.random.PRNGKey(11)
+    want, x_t, _ = text2image(tiny_pipe, prompts, ctrl, num_steps=steps,
+                              rng=rng)
+    sp = SpConfig(mesh=sp_mesh, axis="sp", min_pixels=256)
+    got, _, _ = text2image(tiny_pipe, prompts, ctrl, num_steps=steps,
+                           latent=x_t, sp=sp)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), atol=1.0)
+
+
 def test_sd14_hr_config_exists_with_ring_eligible_sites():
     """The >64² latent config (SURVEY §5 scaling axis): 128² latent has
     16384-pixel self sites — above SpConfig's default min_pixels."""
