@@ -91,11 +91,11 @@ def load_image(path: str, size: int = 512, left: int = 0, right: int = 0,
     return img
 
 
-@partial(jax.jit, static_argnames=("cfg", "progress"))
+@partial(jax.jit, static_argnames=("cfg", "progress", "sp"))
 def _ddim_invert_jit(unet_params, vae_params, cfg: PipelineConfig,
                      schedule: sched_mod.DiffusionSchedule,
                      image: jax.Array, cond: jax.Array,
-                     progress: bool = False):
+                     progress: bool = False, sp=None):
     """image (1,H,W,3) in [-1,1] → all T+1 latents, ascending noise."""
     latent0 = vae_mod.encode(vae_params, cfg.vae, image)
 
@@ -106,7 +106,7 @@ def _ddim_invert_jit(unet_params, vae_params, cfg: PipelineConfig,
     def body(latent, scan_in):
         i, t = scan_in
         progress_mod.emit_step(progress, i)
-        eps, _ = apply_unet(unet_params, cfg.unet, latent, t, cond)
+        eps, _ = apply_unet(unet_params, cfg.unet, latent, t, cond, sp=sp)
         eps = sched_mod.to_epsilon(schedule, eps, t, latent)
         nxt = sched_mod.ddim_next_step(schedule, eps, t, latent)
         return nxt, nxt
@@ -126,7 +126,8 @@ def _adam_update(g, m, v, j, lr, b1=0.9, b2=0.999, eps=1e-8):
     return -lr * mhat / (jnp.sqrt(vhat) + eps), m, v
 
 
-@partial(jax.jit, static_argnames=("cfg", "num_inner_steps", "progress"))
+@partial(jax.jit, static_argnames=("cfg", "num_inner_steps", "progress",
+                                   "sp"))
 def _null_optimize_jit(unet_params, cfg: PipelineConfig,
                        schedule: sched_mod.DiffusionSchedule,
                        latents: jax.Array,        # (T+1, 1, h, w, c) ascending
@@ -135,7 +136,7 @@ def _null_optimize_jit(unet_params, cfg: PipelineConfig,
                        guidance_scale: jax.Array,
                        num_inner_steps: int,
                        epsilon: jax.Array,
-                       progress: bool = False):
+                       progress: bool = False, sp=None):
     """Per-timestep uncond-embedding optimization
     (`/root/reference/null_text.py:574-606`). Returns (T, 1, L, D) f32.
 
@@ -163,7 +164,8 @@ def _null_optimize_jit(unet_params, cfg: PipelineConfig,
         # (`/root/reference/null_text.py:584` latents[len - i - 2]).
         target = jax.lax.dynamic_index_in_dim(
             latents, t_count - 1 - i, axis=0, keepdims=False)
-        eps_cond, _ = apply_unet(unet_params, cfg.unet, latent_cur, t, cond)
+        eps_cond, _ = apply_unet(unet_params, cfg.unet, latent_cur, t, cond,
+                                 sp=sp)
         eps_cond = jax.lax.stop_gradient(eps_cond)
         # The loss's step math and compare run in f32 whatever the model
         # dtype (only the U-Net forwards stay in model dtype): on the bf16
@@ -176,7 +178,7 @@ def _null_optimize_jit(unet_params, cfg: PipelineConfig,
 
         def loss_fn(u):
             eps_u, _ = apply_unet(unet_params, cfg.unet, latent_cur, t,
-                                  u.astype(model_dtype))
+                                  u.astype(model_dtype), sp=sp)
             eps = eps_u + guidance_scale * (eps_cond - eps_u)
             eps = sched_mod.to_epsilon(schedule, eps, t, latent_cur)
             prev = sched_mod.ddim_step(schedule, eps, t, latent_f)
@@ -202,7 +204,7 @@ def _null_optimize_jit(unet_params, cfg: PipelineConfig,
         # Advance with the optimized uncond under full CFG
         # (`/root/reference/null_text.py:602-604`).
         eps_u, _ = apply_unet(unet_params, cfg.unet, latent_cur, t,
-                              u_opt.astype(model_dtype))
+                              u_opt.astype(model_dtype), sp=sp)
         eps = eps_u + guidance_scale * (eps_cond - eps_u)
         eps = sched_mod.to_epsilon(schedule, eps, t, latent_cur)
         latent_next = sched_mod.ddim_step(schedule, eps, t, latent_cur)
@@ -226,10 +228,17 @@ def invert(
     early_stop_epsilon: float = 1e-5,
     dtype=jnp.float32,
     progress: bool = False,
+    sp=None,
 ) -> InversionArtifact:
     """Full null-text inversion (`/root/reference/null_text.py:608-618`):
     DDIM-invert with guidance 1, then optimize per-step uncond embeddings so
-    CFG sampling at full guidance reproduces the input image."""
+    CFG sampling at full guidance reproduces the input image.
+
+    ``sp`` (a :class:`p2p_tpu.models.unet.SpConfig`) shards large
+    self-attention sites with ring attention through both compiled
+    programs — including the optimization's gradient, which recomputes
+    ring-flash blocks through the einsum VJP (`parallel/ring.py`). The
+    long-context path for inverting high-resolution images."""
     cfg = pipe.config
     gs = jnp.asarray(cfg.guidance_scale if guidance_scale is None else guidance_scale,
                      jnp.float32)
@@ -252,7 +261,7 @@ def invert(
             progress_mod.StepReporter(num_steps, "ddim-invert"))
     latent0, x_t, all_latents = _ddim_invert_jit(
         pipe.unet_params, pipe.vae_params, cfg, schedule, image_j, cond,
-        progress=progress)
+        progress=progress, sp=sp)
 
     if progress:
         jax.effects_barrier()  # drain phase-1 callbacks (block_until_ready
@@ -261,7 +270,8 @@ def invert(
             progress_mod.StepReporter(num_steps, "null-text opt"))
     uncond_list = _null_optimize_jit(
         pipe.unet_params, cfg, schedule, all_latents, uncond0, cond, gs,
-        num_inner_steps, jnp.float32(early_stop_epsilon), progress=progress)
+        num_inner_steps, jnp.float32(early_stop_epsilon), progress=progress,
+        sp=sp)
 
     rec = vae_mod.to_uint8(vae_mod.decode(
         pipe.vae_params, cfg.vae, latent0.astype(jnp.float32)))

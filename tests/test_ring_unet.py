@@ -115,6 +115,24 @@ def test_text2image_with_sp_matches_unsharded(sp_mesh, tiny_pipe):
                                np.asarray(want, np.float32), atol=1.0)
 
 
+def test_invert_with_sp_matches_unsharded(sp_mesh, tiny_pipe):
+    """Null-text inversion under an sp plan (ring attention through BOTH
+    compiled programs, including the optimization's gradient via the ring
+    VJP) must match the unsharded inversion."""
+    from p2p_tpu.engine.inversion import invert
+
+    rng = np.random.RandomState(4)
+    image = rng.randint(0, 256, (TINY.image_size, TINY.image_size, 3)
+                        ).astype(np.uint8)
+    kw = dict(num_steps=2, num_inner_steps=2)
+    want = invert(tiny_pipe, image, "a cat riding a bike", **kw)
+    sp = SpConfig(mesh=sp_mesh, axis="sp", min_pixels=256)
+    got = invert(tiny_pipe, image, "a cat riding a bike", sp=sp, **kw)
+    np.testing.assert_allclose(got.x_t, want.x_t, atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(got.uncond_embeddings,
+                               want.uncond_embeddings, atol=1e-4, rtol=1e-3)
+
+
 def test_sd14_hr_config_exists_with_ring_eligible_sites():
     """The >64² latent config (SURVEY §5 scaling axis): 128² latent has
     16384-pixel self sites — above SpConfig's default min_pixels."""
