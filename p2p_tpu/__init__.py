@@ -24,6 +24,7 @@ _EXPORTS = {
     "load_image": "p2p_tpu.engine.inversion",
     "load_pipeline": "p2p_tpu.models.checkpoint",
     "make_controller": "p2p_tpu.controllers.factory",
+    "SpConfig": "p2p_tpu.models.unet",
 }
 
 __all__ = ["MAX_NUM_WORDS", *_EXPORTS]

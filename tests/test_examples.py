@@ -19,6 +19,7 @@ CASES = [
     ("equalizer_sweep.py", ["--preset", "tiny"], None),
     ("prompt_to_prompt_ldm.py", ["--preset", "tiny-ldm"], None),
     ("null_text_w_ptp.py", ["--preset", "tiny"], None),
+    ("ring_attention_highres.py", ["--preset", "tiny"], "y_hat.png"),
 ]
 
 
@@ -26,6 +27,12 @@ def _cpu_env():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    # 8 virtual devices so the sharded examples (equalizer sweep, ring
+    # attention) exercise their multi-device paths, matching the suite.
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     # The examples import the installed package (`pip install -e .
     # --no-build-isolation --no-deps`); PYTHONPATH keeps this test green on
     # a fresh container where site-packages was reset.
