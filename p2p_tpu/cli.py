@@ -167,6 +167,12 @@ def cmd_edit(args) -> int:
 
     from .utils.progress import trace
 
+    if args.batch_seeds and args.attn_maps:
+        # Batched groups carry a leading G axis in the store state the viz
+        # aggregation doesn't index; honored-flags discipline says reject
+        # rather than silently ignore — and before the model load.
+        raise SystemExit("--attn-maps requires the sequential path "
+                         "(drop --batch-seeds)")
     pipe = _build_pipeline(args)
     prompts = [args.source, args.target]
     controller = _make_controller(args, prompts, pipe.tokenizer, args.steps)
@@ -174,6 +180,9 @@ def cmd_edit(args) -> int:
     if args.batch_seeds:
         with trace(args.profile):
             return _edit_batched(args, pipe, prompts, controller, out_dir)
+    from .models.config import unet_layout
+
+    layout = unet_layout(pipe.config.unet)
     with trace(args.profile):
         for seed in args.seeds:
             rng = jax.random.PRNGKey(seed)
@@ -182,19 +191,43 @@ def cmd_edit(args) -> int:
                                       guidance_scale=args.guidance,
                                       scheduler=args.scheduler, rng=rng,
                                       negative_prompt=args.negative_prompt,
-                                      progress=not args.quiet)
-            img, _, _ = text2image(pipe, prompts, controller,
-                                   num_steps=args.steps,
-                                   guidance_scale=args.guidance,
-                                   scheduler=args.scheduler, latent=x_t,
-                                   negative_prompt=args.negative_prompt,
-                                   progress=not args.quiet)
+                                      progress=not args.quiet, layout=layout)
+            img, _, store = text2image(pipe, prompts, controller,
+                                       num_steps=args.steps,
+                                       guidance_scale=args.guidance,
+                                       scheduler=args.scheduler, latent=x_t,
+                                       negative_prompt=args.negative_prompt,
+                                       progress=not args.quiet, layout=layout,
+                                       return_store=bool(args.attn_maps))
             # y / y_hat naming per `/root/reference/main.py:375-380,435-444`.
             _save(np.asarray(base[0]),
                   os.path.join(out_dir, f"{seed:05d}_y.jpg"))
             _save(np.asarray(img[1]),
                   os.path.join(out_dir, f"{seed:05d}_y_hat.jpg"))
+            if args.attn_maps:
+                _save_attn_maps(args, pipe, layout, store, seed)
     return 0
+
+
+def _save_attn_maps(args, pipe, layout, store, seed) -> None:
+    """Per-token cross-attention heatmaps of the edited prompt — the
+    reference's `show_cross_attention` notebook workflow
+    (`/root/reference/main.py:310-327`) as a CLI artifact."""
+    from .utils import viz
+
+    # The reference reads the 16×16 level at SD's 64² latent
+    # (`/root/reference/main.py:302,327`): a quarter of the latent side.
+    # Model-derived: largest stored cross resolution ≤ sample_size // 4,
+    # falling back to the largest stored at all (tiny test models).
+    stored = sorted({m.resolution for m in layout.stored_metas()
+                     if m.is_cross and m.place in ("up", "down")})
+    want = pipe.config.unet.sample_size // 4
+    res = max((r for r in stored if r <= want), default=stored[-1])
+    os.makedirs(args.attn_maps, exist_ok=True)
+    viz.show_cross_attention(
+        pipe.tokenizer, args.target, layout, store, args.steps, res,
+        ("up", "down"), select=1,
+        save_path=os.path.join(args.attn_maps, f"{seed:05d}_cross_attn.png"))
 
 
 def cmd_invert(args) -> int:
@@ -315,6 +348,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "through the dp sweep engine (two compiled programs "
                         "total instead of two per seed; sharded over the "
                         "mesh when more than one device is visible)")
+    e.add_argument("--attn-maps", default=None, metavar="DIR",
+                   help="also write per-token cross-attention heatmaps of "
+                        "the edited prompt (the reference's "
+                        "show_cross_attention) into DIR")
     e.set_defaults(fn=cmd_edit)
 
     # Inversion is DDIM by construction (`/root/reference/null_text.py:23`);

@@ -110,6 +110,10 @@ def show_cross_attention(tokenizer, prompt: str, layout: AttnLayout,
     decoder = lambda t: tokenizer.decode([t])
     maps = aggregate_attention(layout, state, num_steps, res, from_where, True,
                                select)
+    # Sampling truncates prompts to the context length via pad_ids; the raw
+    # encode here is unpadded/untruncated, so clamp to the stored K or an
+    # over-long prompt would IndexError after the whole expensive run.
+    ids = ids[:maps.shape[-1]]
     images = []
     for i in range(len(ids)):
         m = maps[:, :, i]

@@ -55,6 +55,26 @@ def test_edit_batch_seeds_matches_sequential(tmp_path):
             assert np.abs(a - b).mean() < 3.0, f"seed {seed} {kind} diverged"
 
 
+def test_edit_attn_maps_writes_heatmaps(tmp_path):
+    out_dir = os.path.join(tmp_path, "run")
+    maps_dir = os.path.join(tmp_path, "maps")
+    assert main(["edit", "--quiet", "--source", "a cat riding a bike",
+                 "--target", "a dog riding a bike", "--mode", "replace",
+                 "--steps", "2", "--seeds", "5", "--out-dir", out_dir,
+                 "--attn-maps", maps_dir]) == 0
+    p = os.path.join(maps_dir, "00005_cross_attn.png")
+    assert os.path.exists(p)
+    from PIL import Image
+
+    assert np.asarray(Image.open(p)).ndim == 3  # a real RGB heatmap grid
+    # Incompatible with the batched path: rejected loudly, not ignored.
+    with pytest.raises(SystemExit):
+        main(["edit", "--quiet", "--source", "a", "--target", "b",
+              "--mode", "replace", "--steps", "2", "--seeds", "1,2",
+              "--batch-seeds", "--attn-maps", maps_dir,
+              "--out-dir", out_dir])
+
+
 def test_invert_then_replay(tmp_path):
     from PIL import Image
 
