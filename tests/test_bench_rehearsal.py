@@ -1,0 +1,49 @@
+"""The bench's own CI: `--preset rehearse` runs every on-accel variant and
+secondary block at tiny scale and exits nonzero if any block fails or is
+skipped. This pins the driver's scoring artifact (bench.py) against
+regressions the tiny fallback path would never reach — it already caught
+a bf16 compile break in the null-text optimizer before it burned chip time.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXPECTED_KEYS = {
+    "metric", "value", "unit", "vs_baseline", "variant",
+    "single_group_imgs_per_s",
+    "batched_2groups_imgs_per_s", "batched_4groups_imgs_per_s",
+    "batched_8groups_imgs_per_s",
+    "dpm20_imgs_per_s", "dpm20_batched_8groups_imgs_per_s",
+    "reweight_eqsweep_4groups_imgs_per_s",
+    "refine_localblend_imgs_per_s",
+    "ldm256_8prompt_imgs_per_s",
+    "nullinv_s_per_image",
+}
+
+
+@pytest.mark.slow
+def test_bench_rehearsal_green_and_complete():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, ".jax_cache"))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--preset", "rehearse"],
+        env=env, timeout=1500, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    assert proc.returncode == 0, (
+        f"rehearsal failed:\n{proc.stderr[-3000:]}")
+    last = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    doc = json.loads(last)
+    assert doc["metric"] == "bench_rehearsal_imgs_per_s"
+    missing = EXPECTED_KEYS - set(doc)
+    assert not missing, f"rehearsal line missing keys: {sorted(missing)}"
+    assert doc["value"] > 0
