@@ -25,6 +25,8 @@ _EXPORTS = {
     "load_pipeline": "p2p_tpu.models.checkpoint",
     "make_controller": "p2p_tpu.controllers.factory",
     "SpConfig": "p2p_tpu.models.unet",
+    "save_pipeline_native": "p2p_tpu.models.native",
+    "load_pipeline_native": "p2p_tpu.models.native",
 }
 
 __all__ = ["MAX_NUM_WORDS", *_EXPORTS]
