@@ -25,7 +25,10 @@ from p2p_tpu.models.checkpoint import (export_state_dict,
 
 def _write_bin(sd, dirpath, filename):
     os.makedirs(dirpath, exist_ok=True)
-    torch.save({k: torch.from_numpy(np.ascontiguousarray(v))
+    # np.array: one writable C-contiguous copy — jax exports arrive as
+    # non-writable views and torch.from_numpy warns on those (the suite's
+    # one warning otherwise).
+    torch.save({k: torch.from_numpy(np.array(v))
                 for k, v in sd.items()}, os.path.join(dirpath, filename))
 
 

@@ -136,6 +136,18 @@ def _case_ldm(tiny):
     return img
 
 
+def _case_dpm(tiny):
+    """The quality-matched operating point (bench.py's DPM-Solver++(2M)
+    secondary): same Replace edit, dpm multistep scheduler."""
+    ctrl = factory.attention_replace(
+        PROMPTS, STEPS, cross_replace_steps=0.8, self_replace_steps=0.4,
+        tokenizer=tiny.tokenizer, self_max_pixels=8 * 8,
+        max_len=TINY.text.max_length)
+    img, _, _ = text2image(tiny, PROMPTS, ctrl, num_steps=STEPS,
+                           scheduler="dpm", rng=jax.random.PRNGKey(46))
+    return img
+
+
 GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
 
 # Pinned on CPU (x86-64, f32). Regenerate intentionally — see module docstring.
@@ -145,6 +157,7 @@ GOLDEN = {
     "reweight_sweep": "0b45bfcc134a7dda",
     "nulltext": "2bb2980052c44f63",
     "ldm": "78f4e49b5a2cb362",
+    "dpm": "93136b89310fc4d9",
 }
 
 CASES = {
@@ -153,6 +166,7 @@ CASES = {
     "reweight_sweep": _case_reweight_sweep,
     "nulltext": _case_nulltext,
     "ldm": _case_ldm,
+    "dpm": _case_dpm,
 }
 
 
