@@ -195,14 +195,25 @@ class _HookCtx:
 class SpConfig:
     """Sequence-parallel plan for self-attention: shard the pixel axis of
     every *untouched* self site with ≥ ``min_pixels`` pixels over mesh axis
-    ``axis`` and attend with ring communication (`parallel/ring.py`). This is
-    the scaling axis the reference lacks entirely (SURVEY §5: resolution is
-    quadratic in pixels); controller-touched sites stay local because edits
-    read whole probability rows."""
+    ``axis``. This is the scaling axis the reference lacks entirely
+    (SURVEY §5: resolution is quadratic in pixels); controller-touched
+    sites stay local because edits read whole probability rows.
+
+    ``mode`` selects the communication scheme: ``"ring"`` rotates k/v
+    shards via ppermute (`parallel/ring.py`); ``"alltoall"`` redistributes
+    to head sharding for one dense local attention per device
+    (Ulysses-style, `parallel/alltoall.py`) — sites whose head count the
+    axis doesn't divide fall back to the ring, which is always valid."""
 
     mesh: Any                 # jax.sharding.Mesh
     axis: str = "sp"
     min_pixels: int = 64 * 64
+    mode: str = "ring"
+
+    def __post_init__(self):
+        if self.mode not in ("ring", "alltoall"):
+            raise ValueError(f"unknown sp mode {self.mode!r} "
+                             f"(expected 'ring' or 'alltoall')")
 
 
 def _apply_attention(p: Params, x: jax.Array, context: jax.Array, heads: int,
@@ -255,6 +266,11 @@ def _apply_attention(p: Params, x: jax.Array, context: jax.Array, heads: int,
                 f"pixels not divisible by mesh axis {ctx.sp.axis!r}={n}; "
                 f"running this site unsharded (local flash)", stacklevel=2)
             out = nn.fused_attention(q, k, v, scale)
+        elif ctx.sp.mode == "alltoall" and q.shape[1] % n == 0:
+            from ..parallel.alltoall import alltoall_self_attention
+
+            out = alltoall_self_attention(q, k, v, scale, ctx.sp.mesh,
+                                          ctx.sp.axis)
         else:
             from ..parallel.ring import ring_self_attention
 

@@ -41,6 +41,40 @@ def test_ring_attention_matches_single_device(devices):
                                atol=1e-5, rtol=1e-5)
 
 
+def test_alltoall_attention_matches_single_device(devices):
+    """Ulysses-style all-to-all sequence parallelism: head redistribution +
+    one dense local attention must equal full attention."""
+    from jax.sharding import Mesh
+    from p2p_tpu.parallel import alltoall_self_attention
+    from p2p_tpu.models import nn
+
+    mesh = Mesh(np.asarray(devices[:4]).reshape(4), ("sp",))
+    rng = np.random.RandomState(11)
+    b, h, s, d = 2, 8, 64, 16  # h % 4 == 0, s % 4 == 0
+    q, k, v = (jnp.asarray(rng.randn(b, h, s, d).astype(np.float32))
+               for _ in range(3))
+    scale = 1.0 / np.sqrt(d)
+    want = jnp.einsum(
+        "bhqk,bhkd->bhqd",
+        nn.attention_probs(q, k, scale).astype(v.dtype), v)
+    got = alltoall_self_attention(q, k, v, scale, mesh, "sp")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_alltoall_attention_rejects_indivisible(devices):
+    from jax.sharding import Mesh
+    from p2p_tpu.parallel import alltoall_self_attention
+
+    mesh = Mesh(np.asarray(devices[:4]).reshape(4), ("sp",))
+    q = jnp.zeros((1, 6, 64, 8))  # 6 heads % 4 != 0
+    with pytest.raises(ValueError, match="head count"):
+        alltoall_self_attention(q, q, q, 1.0, mesh, "sp")
+    q = jnp.zeros((1, 8, 62, 8))  # 62 pixels % 4 != 0
+    with pytest.raises(ValueError, match="sequence length"):
+        alltoall_self_attention(q, q, q, 1.0, mesh, "sp")
+
+
 def test_ring_attention_rejects_indivisible(devices):
     mesh = make_mesh(8, tp=1, axis_names=("sp", "unused"), devices=devices)
     q = jnp.zeros((1, 1, 100, 8))

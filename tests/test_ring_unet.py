@@ -133,6 +133,40 @@ def test_invert_with_sp_matches_unsharded(sp_mesh, tiny_pipe):
                                want.uncond_embeddings, atol=1e-4, rtol=1e-3)
 
 
+def test_alltoall_unet_matches_local(sp_mesh):
+    """SpConfig(mode='alltoall') on a head-divisible axis: TINY has 2 heads,
+    so a 2-device sp mesh uses all-to-all at the 256-pixel sites; the
+    forward must match the unsharded program. On the 8-device mesh (heads
+    2 % 8 != 0) every site must fall back to the ring — same answer."""
+    cfg = TINY.unet
+    layout = unet_layout(cfg)
+    params = init_unet(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, cfg.sample_size, cfg.sample_size,
+                              cfg.in_channels).astype(np.float32))
+    ctx = jnp.asarray(rng.randn(2, cfg.context_len, cfg.context_dim)
+                      .astype(np.float32))
+    t = jnp.int32(300)
+
+    eps_local, _ = jax.jit(
+        lambda p, x, c: apply_unet(p, cfg, x, t, c, layout=layout))(params, x, ctx)
+
+    mesh2 = Mesh(np.asarray(jax.devices("cpu")[:2]).reshape(2), ("sp",))
+    for mesh, label in ((mesh2, "alltoall"), (sp_mesh, "ring-fallback")):
+        sp = SpConfig(mesh=mesh, axis="sp", min_pixels=256, mode="alltoall")
+        eps_sp, _ = jax.jit(
+            lambda p, x, c, sp=sp: apply_unet(p, cfg, x, t, c, layout=layout,
+                                              sp=sp))(params, x, ctx)
+        np.testing.assert_allclose(
+            np.asarray(eps_sp), np.asarray(eps_local),
+            atol=2e-5, rtol=1e-4, err_msg=label)
+
+
+def test_spconfig_rejects_unknown_mode(sp_mesh):
+    with pytest.raises(ValueError, match="unknown sp mode"):
+        SpConfig(mesh=sp_mesh, axis="sp", mode="ulysses")
+
+
 def test_sd14_hr_config_exists_with_ring_eligible_sites():
     """The >64² latent config (SURVEY §5 scaling axis): 128² latent has
     16384-pixel self sites — above SpConfig's default min_pixels."""
