@@ -247,9 +247,11 @@ def torch_blend_oracle(maps, alpha, x_t_nchw, th, start_ok=True):
     import torch
     import torch.nn.functional as nnf
 
-    maps = torch.from_numpy(maps)          # (B, SH, res, res, L)
-    alpha = torch.from_numpy(alpha)        # (B, 1, 1, 1, L)
-    x_t = torch.from_numpy(x_t_nchw)       # (B, C, H, W)
+    # np.array: writable copies — torch.from_numpy warns on the read-only
+    # views jax hands out.
+    maps = torch.from_numpy(np.array(maps))     # (B, SH, res, res, L)
+    alpha = torch.from_numpy(np.array(alpha))   # (B, 1, 1, 1, L)
+    x_t = torch.from_numpy(np.array(x_t_nchw))  # (B, C, H, W)
     m = (maps * alpha).sum(-1).mean(1, keepdim=True)  # (B, 1, res, res)
     m = nnf.max_pool2d(m, (3, 3), (1, 1), padding=(1, 1))
     m = nnf.interpolate(m, size=x_t.shape[2:])
