@@ -101,6 +101,26 @@ def cmd_generate(args) -> int:
     from .utils.progress import trace
 
     pipe = _build_pipeline(args)
+
+    def out_path(seed):
+        if len(args.seeds) == 1:
+            return args.out
+        root, ext = os.path.splitext(args.out)
+        return f"{root}_{seed:05d}{ext}"
+
+    if args.batch_seeds:
+        from .parallel import sweep
+
+        with trace(args.profile):
+            ctx, lats, mesh = _group_setup(pipe, [args.prompt], args.seeds,
+                                           args.negative_prompt)
+            imgs, _ = sweep(pipe, ctx, lats, None, num_steps=args.steps,
+                            guidance_scale=args.guidance,
+                            scheduler=args.scheduler, mesh=mesh)
+            for i, seed in enumerate(args.seeds):
+                _save(np.asarray(imgs[i][0]), out_path(seed))
+        return 0
+
     with trace(args.profile):
         for seed in args.seeds:
             img, _, _ = text2image(pipe, [args.prompt], None,
@@ -110,42 +130,50 @@ def cmd_generate(args) -> int:
                                    rng=jax.random.PRNGKey(seed),
                                    negative_prompt=args.negative_prompt,
                                    progress=not args.quiet)
-            out = args.out
-            if len(args.seeds) > 1:
-                root, ext = os.path.splitext(out)
-                out = f"{root}_{seed:05d}{ext}"
-            _save(np.asarray(img[0]), out)
+            _save(np.asarray(img[0]), out_path(seed))
     return 0
+
+
+def _group_setup(pipe, prompts, seeds, negative_prompt):
+    """Shared batched-sweep setup: per-group [uncond; cond] context, one
+    base latent per seed shared across the group's prompts (the shared-seed
+    expansion of `/root/reference/ptp_utils.py:88-95`), and a dp mesh over
+    up to min(n_seeds, n_devices) devices (a 4-seed sweep on an 8-device
+    slice still rides 4 — same gate as examples/equalizer_sweep.py).
+    Returns (ctx (G,2B,L,D), lats (G,B,...), mesh-or-None)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .engine.sampler import encode_prompts
+    from .parallel import make_mesh
+
+    g = len(seeds)
+    cond = encode_prompts(pipe, prompts)
+    uncond = encode_prompts(pipe, [negative_prompt or ""] * len(prompts))
+    ctx = jnp.concatenate([uncond, cond], axis=0)
+    ctx = jnp.broadcast_to(ctx[None], (g,) + ctx.shape)
+    base = jnp.stack([jax.random.normal(jax.random.PRNGKey(s),
+                                        (1,) + pipe.latent_shape)
+                      for s in seeds])
+    lats = jnp.broadcast_to(base, (g, len(prompts)) + pipe.latent_shape)
+    n_dev = min(len(jax.devices()), g)
+    mesh = (make_mesh(n_dev) if n_dev > 1 and g % n_dev == 0 else None)
+    return ctx, lats, mesh
 
 
 def _edit_batched(args, pipe, prompts, controller, out_dir) -> int:
     """The seed sweep as two compiled programs total (baseline + edit), all
     seeds riding the group axis of the dp sweep engine — the reference's
     sequential per-seed loop (`/root/reference/main.py:417-444`) at sweep
-    throughput. Shards over a dp mesh when several devices are visible and
-    the seed count divides them."""
+    throughput."""
     import jax
     import jax.numpy as jnp
 
-    from .engine.sampler import encode_prompts
-    from .parallel import make_mesh, sweep
+    from .parallel import sweep
 
     g = len(args.seeds)
-    cond = encode_prompts(pipe, prompts)
-    uncond = encode_prompts(pipe, [args.negative_prompt or ""] * len(prompts))
-    ctx = jnp.concatenate([uncond, cond], axis=0)
-    ctx = jnp.broadcast_to(ctx[None], (g,) + ctx.shape)
-    # One base latent per seed, shared across the group's prompts (the
-    # shared-seed expansion of `/root/reference/ptp_utils.py:88-95`).
-    base = jnp.stack([jax.random.normal(jax.random.PRNGKey(s),
-                                        (1,) + pipe.latent_shape)
-                      for s in args.seeds])
-    lats = jnp.broadcast_to(base, (g, len(prompts)) + pipe.latent_shape)
-
-    # Shard over up to min(g, n_dev) devices (a 4-seed sweep on an 8-device
-    # slice still rides 4 devices — same gate as examples/equalizer_sweep.py).
-    n_dev = min(len(jax.devices()), g)
-    mesh = (make_mesh(n_dev) if n_dev > 1 and g % n_dev == 0 else None)
+    ctx, lats, mesh = _group_setup(pipe, prompts, args.seeds,
+                                   args.negative_prompt)
     kw = dict(num_steps=args.steps, guidance_scale=args.guidance,
               scheduler=args.scheduler, mesh=mesh)
     base_imgs, _ = sweep(pipe, ctx, lats, None, **kw)
@@ -336,6 +364,9 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--prompt", required=True)
     g.add_argument("--out", default="outputs/image.png",
                    help="output path; seed index suffixed when sweeping")
+    g.add_argument("--batch-seeds", action="store_true",
+                   help="run the whole seed sweep as one batched program "
+                        "through the dp sweep engine")
     g.set_defaults(fn=cmd_generate)
 
     e = sub.add_parser("edit", help="prompt-to-prompt edit with seed sweep")

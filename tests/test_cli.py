@@ -32,6 +32,23 @@ def test_edit_writes_pairs(tmp_path):
     assert os.path.exists(os.path.join(out_dir, "00007_y_hat.jpg"))
 
 
+def test_generate_batch_seeds_matches_sequential(tmp_path):
+    from PIL import Image
+
+    common = ["generate", "--quiet", "--prompt", "a cat riding a bike",
+              "--steps", "2", "--seeds", "4,8"]
+    seq = os.path.join(tmp_path, "s.png")
+    bat = os.path.join(tmp_path, "b.png")
+    assert main(common + ["--out", seq]) == 0
+    assert main(common + ["--batch-seeds", "--out", bat]) == 0
+    for seed in (4, 8):
+        a = np.asarray(Image.open(
+            os.path.join(tmp_path, f"s_{seed:05d}.png")), np.float32)
+        b = np.asarray(Image.open(
+            os.path.join(tmp_path, f"b_{seed:05d}.png")), np.float32)
+        assert np.abs(a - b).mean() < 1.0, f"seed {seed} diverged"
+
+
 def test_edit_batch_seeds_matches_sequential(tmp_path):
     """--batch-seeds runs the sweep engine (two programs total); its y/y_hat
     pairs must match the sequential per-seed loop on the same seeds (both
