@@ -36,7 +36,7 @@ from p2p_tpu.utils.tokenizer import ClipBpeTokenizer, _bytes_to_unicode
 
 def _write_bin(sd: dict, dirpath, filename):
     os.makedirs(dirpath, exist_ok=True)
-    torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()},
+    torch.save({k: torch.from_numpy(np.array(v)) for k, v in sd.items()},
                os.path.join(dirpath, filename))
 
 

@@ -34,7 +34,8 @@ from p2p_tpu.models.unet import (
 
 
 def _to_t(a):
-    return torch.from_numpy(np.asarray(a, dtype=np.float32))
+    # np.array: writable copy (torch.from_numpy warns on jax's read-only views)
+    return torch.from_numpy(np.array(a, dtype=np.float32))
 
 
 # ---------------------------------------------------------------------------
