@@ -156,8 +156,15 @@ def _group_setup(pipe, prompts, seeds, negative_prompt):
                                         (1,) + pipe.latent_shape)
                       for s in seeds])
     lats = jnp.broadcast_to(base, (g, len(prompts)) + pipe.latent_shape)
-    n_dev = min(len(jax.devices()), g)
-    mesh = (make_mesh(n_dev) if n_dev > 1 and g % n_dev == 0 else None)
+    # Shard over the largest divisor of g that fits the visible devices
+    # (g=6 on 4 devices rides 3, not 1); say so when parallelism degrades,
+    # rather than silently losing what --batch-seeds advertises.
+    cap = min(len(jax.devices()), g)
+    n_dev = max((d for d in range(1, cap + 1) if g % d == 0), default=1)
+    if n_dev < cap:
+        print(f"--batch-seeds: {g} seeds not divisible by {cap} devices; "
+              f"sharding over {n_dev}", file=sys.stderr)
+    mesh = make_mesh(n_dev) if n_dev > 1 else None
     return ctx, lats, mesh
 
 
@@ -249,6 +256,9 @@ def _save_attn_maps(args, pipe, layout, store, seed) -> None:
     # falling back to the largest stored at all (tiny test models).
     stored = sorted({m.resolution for m in layout.stored_metas()
                      if m.is_cross and m.place in ("up", "down")})
+    if not stored:
+        raise SystemExit("--attn-maps: no stored up/down cross-attention "
+                         "sites in this model config")
     want = pipe.config.unet.sample_size // 4
     res = max((r for r in stored if r <= want), default=stored[-1])
     os.makedirs(args.attn_maps, exist_ok=True)
@@ -366,7 +376,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="output path; seed index suffixed when sweeping")
     g.add_argument("--batch-seeds", action="store_true",
                    help="run the whole seed sweep as one batched program "
-                        "through the dp sweep engine")
+                        "through the dp sweep engine (no per-step progress "
+                        "output in batched mode)")
     g.set_defaults(fn=cmd_generate)
 
     e = sub.add_parser("edit", help="prompt-to-prompt edit with seed sweep")
@@ -378,7 +389,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run the whole seed sweep as batched edit groups "
                         "through the dp sweep engine (two compiled programs "
                         "total instead of two per seed; sharded over the "
-                        "mesh when more than one device is visible)")
+                        "mesh when more than one device is visible; no "
+                        "per-step progress output in batched mode)")
     e.add_argument("--attn-maps", default=None, metavar="DIR",
                    help="also write per-token cross-attention heatmaps of "
                         "the edited prompt (the reference's "

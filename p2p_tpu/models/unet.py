@@ -272,6 +272,17 @@ def _apply_attention(p: Params, x: jax.Array, context: jax.Array, heads: int,
             out = alltoall_self_attention(q, k, v, scale, ctx.sp.mesh,
                                           ctx.sp.axis)
         else:
+            if ctx.sp.mode == "alltoall":
+                # Same user-visible note as the pixel-indivisible fallback
+                # above: someone benchmarking alltoall must not unknowingly
+                # measure ring (warnings module dedups per call site).
+                import warnings
+
+                warnings.warn(
+                    f"sequence-parallel site {meta.layer_idx}: "
+                    f"{q.shape[1]} heads not divisible by mesh axis "
+                    f"{ctx.sp.axis!r}={n}; alltoall falls back to ring "
+                    f"at this site", stacklevel=2)
             from ..parallel.ring import ring_self_attention
 
             out = ring_self_attention(q, k, v, scale, ctx.sp.mesh, ctx.sp.axis)

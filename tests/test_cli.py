@@ -112,3 +112,24 @@ def test_invert_then_replay(tmp_path):
 def test_rejected_unknown_flag():
     with pytest.raises(SystemExit):
         main(["replay", "--quiet", "--artifact", "x.npz", "--scheduler", "plms"])
+
+
+def test_group_setup_shards_over_largest_divisor(tiny_pipe, capsys):
+    """9 seeds on 8 visible devices must ride a 3-device dp mesh (largest
+    divisor), not silently fall back to one device (ADVICE r3), and say so."""
+    import jax
+
+    from p2p_tpu.cli import _group_setup
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    seeds = list(range(9))
+    ctx, lats, mesh = _group_setup(tiny_pipe, ["a cat"], seeds, None)
+    assert lats.shape[0] == 9
+    assert mesh is not None and mesh.devices.size == 3
+    assert "sharding over 3" in capsys.readouterr().err
+
+    # Divisible sweep keeps the full gate: 8 seeds -> 8 devices, no note.
+    _, _, mesh8 = _group_setup(tiny_pipe, ["a cat"], list(range(8)), None)
+    assert mesh8.devices.size == 8
+    assert "sharding over" not in capsys.readouterr().err

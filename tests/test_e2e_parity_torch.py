@@ -38,6 +38,10 @@ import jax.numpy as jnp
 torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 
+# End-to-end torch-pipeline parity is the suite's most expensive family
+# (~10 s per case warm, minutes cold): slow lane (VERDICT r3 weak #5).
+pytestmark = pytest.mark.slow
+
 from p2p_tpu.controllers import factory
 from p2p_tpu.engine.sampler import Pipeline, text2image
 from p2p_tpu.models import TINY, init_text_encoder, init_unet

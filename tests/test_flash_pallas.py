@@ -162,6 +162,7 @@ def test_ring_attention_flash_chunks_parity():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_flash_grad_falls_back_to_einsum():
     # The flash chunk's custom VJP recomputes through the einsum block, so a
     # differentiated sequence-parallel site (e.g. inversion under SpConfig)

