@@ -569,7 +569,13 @@ def _measure(preset):
                   needs_sweep=True)
         secondary("refine+blend secondary", refine_localblend)
         secondary("ldm256 secondary", ldm256_batch, needs_sweep=True)
-        secondary("null-inversion secondary", null_inversion, min_left=900)
+        # min_left=420: the warm-cache need (chip_window.sh primes both
+        # inversion programs) is two sampling-scale passes (~2-3 min);
+        # 900 made the metric unreachable inside realistic ~26-min windows
+        # (VERDICT r3 weak #4). A cold-cache run may still be timeout-killed
+        # here, but nullinv runs last so a kill can no longer lose earlier
+        # extras — reachable-when-warm beats never-reported.
+        secondary("null-inversion secondary", null_inversion, min_left=420)
 
     if preset == "rehearse" and problems:
         print(f"REHEARSAL INCOMPLETE ({len(problems)} block(s)): "

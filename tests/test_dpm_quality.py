@@ -48,55 +48,64 @@ def _lam(a):
     return 0.5 * math.log(a / (1.0 - a))
 
 
+# ∫ e^λ sin λ dλ in closed form: e^λ (sin λ − cos λ) / 2.
+def _anti(l):
+    return math.exp(l) * (math.sin(l) - math.cos(l)) / 2.0
+
+
 def _solve(kind, n):
     """Integrate the analytic problem over [T_STOP, T_START] with the real
-    sampler step functions; returns the final scalar state."""
+    sampler step functions, propagating the EXACT solution alongside (the
+    homogeneous part is shared, so from any (λ_a, x_a) the truth is
+    x_b = (σ_b/σ_a)·x_a + σ_b·(anti(λ_b) − anti(λ_a))). Returns the max
+    per-step abs deviation from the exact trajectory — max-abs, not the
+    signed endpoint difference, so oscillation-phase cancellation along
+    sin(λ) cannot flatter a solver."""
     sched = S.make_schedule(n, kind="ddim")
     x = jnp.asarray([1.0])
+    x_true = 1.0
     ms = S.init_dpm_state(x.shape)
+    max_err = 0.0
     for t in np.asarray(sched.timesteps):
         if t > T_START or t - sched.step_size < T_STOP:
             continue
         a = float(S._alpha_at(sched, jnp.int32(t)))
+        a_n = float(S._alpha_at(sched, jnp.int32(t - sched.step_size)))
         eps = (x - math.sqrt(a) * math.sin(_lam(a))) / math.sqrt(1.0 - a)
         if kind == "dpm":
             ms, x = S.dpm_step(sched, ms, eps, jnp.int32(t), x)
         else:
             x = S.ddim_step(sched, eps, jnp.int32(t), x)
-    return float(x[0])
-
-
-def _exact():
-    sched = S.make_schedule(10)
-    a0 = float(S._alpha_at(sched, jnp.int32(T_START)))
-    a1 = float(S._alpha_at(sched, jnp.int32(T_STOP)))
-    la, lb = _lam(a0), _lam(a1)
-    # ∫ e^λ sin λ dλ in closed form: e^λ (sin λ − cos λ) / 2.
-    anti = lambda l: math.exp(l) * (math.sin(l) - math.cos(l)) / 2.0
-    s0, s1 = math.sqrt(1.0 - a0), math.sqrt(1.0 - a1)
-    return (s1 / s0) * 1.0 + s1 * (anti(lb) - anti(la))
+        s_a, s_n = math.sqrt(1.0 - a), math.sqrt(1.0 - a_n)
+        x_true = (s_n / s_a) * x_true + s_n * (_anti(_lam(a_n)) - _anti(_lam(a)))
+        max_err = max(max_err, abs(float(x[0]) - x_true))
+    return max_err
 
 
 def test_dpm20_beats_ddim50_solver_accuracy():
-    want = _exact()
-    err = {f"{kind}{n}": abs(_solve(kind, n) - want)
+    err = {f"{kind}{n}": _solve(kind, n)
            for kind, n in (("ddim", 20), ("ddim", 50),
                            ("dpm", 10), ("dpm", 20))}
 
     # The quality-matched claim, measured: 20-step DPM-Solver++ is at least
     # 3× more accurate than 50-step DDIM on the formed trajectory (measured
-    # margin ~10×; 3× leaves platform-drift headroom). Even 10-step DPM
+    # margin ~5.6×; 3× leaves platform-drift headroom). Even 10-step DPM
     # must beat 20-step DDIM.
     assert err["dpm20"] * 3 < err["ddim50"], err
     assert err["dpm10"] < err["ddim20"], err
-    # And DDIM behaves like the order-1 method it is (sanity on the setup).
+    # Convergence sanity: DDIM order-1, DPM order-2 (monotone in steps —
+    # the max-abs trajectory metric rules out endpoint cancellation).
     assert err["ddim50"] < err["ddim20"], err
+    assert err["dpm20"] < err["dpm10"], err
 
     doc = {
         "problem": "x0-pred sin(lambda), interior interval t in [100, 900], "
-                   "SD scaled_linear betas, exact antiderivative reference",
+                   "SD scaled_linear betas; metric: max per-step abs "
+                   "deviation from the exact trajectory (antiderivative "
+                   "reference propagated alongside)",
         "abs_error": {k: round(v, 8) for k, v in err.items()},
-        "claim": "dpm20_error*3 < ddim50_error (measured margin ~10x)",
+        "claim": "dpm20_error*3 < ddim50_error (measured margin ~5.6x); "
+                 "dpm order-2 convergence visible: dpm10/dpm20 ~ 4.1x",
     }
     if os.environ.get("P2P_REGEN_GOLDEN"):
         with open(GOLDEN, "w") as f:

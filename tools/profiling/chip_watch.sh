@@ -14,9 +14,12 @@ while true; do
   # Stage 1 (cheap): the relay's remote-compile port. rc=7 → relay dead
   # (SKILL.md failure modes); only an accepting port warrants the python
   # probe, which can itself hang minutes on a wedged lease.
+  # Connect-level predicate (same as bench.py's _relay_port_accepts): only
+  # rc 7 (refused) / 28 (timeout) mean the port is dead; any post-connect
+  # outcome (incl. resets) is worth the real python probe.
   curl -s -o /dev/null --max-time 5 http://127.0.0.1:8083/
   rc=$?
-  if [ "$rc" -eq 0 ] || [ "$rc" -eq 22 ] || [ "$rc" -eq 52 ]; then
+  if [ "$rc" -ne 7 ] && [ "$rc" -ne 28 ]; then
     timeout 90 python - <<'EOF' > /dev/null 2>&1
 import jax
 assert jax.devices()[0].platform != "cpu"
