@@ -74,6 +74,31 @@ def test_load_last_onchip_absent_dir_is_none(tmp_path, monkeypatch):
     assert bench._load_last_onchip() is None
 
 
+def test_probe_port_gate_only_skips_nonfinal_loopback_attempts(monkeypatch):
+    """The relay-port fast path must never replace the real probe: with the
+    loopback relay env set and the port dead, the python probe still runs on
+    the final attempt; with any other attachment it runs on every attempt."""
+    bench = _import_bench()
+    calls = []
+
+    class _Proc:
+        stdout = ""  # no PLATFORM line → the loop keeps retrying
+
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: calls.append(1) or _Proc())
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench, "_relay_port_accepts", lambda **k: False)
+
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    assert bench._probe_accelerator(attempts=3) is False
+    assert len(calls) == 1  # dead port short-circuits attempts 1-2 only
+
+    calls.clear()
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS")
+    assert bench._probe_accelerator(attempts=3) is False
+    assert len(calls) == 3  # non-loopback attachment: no port gating at all
+
+
 @pytest.mark.slow
 def test_bench_rehearsal_green_and_complete():
     env = dict(os.environ)
