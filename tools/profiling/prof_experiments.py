@@ -98,6 +98,56 @@ for dt, name in ((jnp.float32, "vae f32"), (jnp.bfloat16, "vae bf16")):
     t0 = time.perf_counter(); np.asarray(vdec(vparams, lat))
     print(f"{name}: {(time.perf_counter()-t0)*1000:.0f} ms", flush=True)
 
+# 5b. head_dim pad 40->128 (full MXU lane width; same exactness argument
+# as pad64 -- measure whether Mosaic's internal padding already covers it).
+def fused_pad128(q, k, v, scale, mask=None):
+    d = q.shape[-1]
+    if mask is None and q.shape[-2] == k.shape[-2] and q.shape[-2] >= 2048 and d < 128:
+        pad = [(0, 0)] * (q.ndim - 1) + [(0, 128 - d)]
+        out = orig_fused(jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                         scale)
+        return out[..., :d]
+    return orig_fused(q, k, v, scale, mask)
+nn_mod.fused_attention = fused_pad128
+unet_mod.nn.fused_attention = fused_pad128
+time_scan(4, "flash head_dim pad128")
+nn_mod.fused_attention = orig_fused
+unet_mod.nn.fused_attention = orig_fused
+
+# 5c. QKV-fused projections: concat the q/k/v kernels inside the forward --
+# one (P,C)x(C,3C) MXU op per self site (k/v fused at cross sites) instead
+# of three separate dots; the concat is loop-invariant so XLA hoists it out
+# of the scan. Exact parity (same weights, split after); identity
+# controller only (bit-exact on CPU at TINY scale: same dots, split after).
+orig_attn = unet_mod._apply_attention
+def attn_fused_qkv(p, x, context, heads, ctx, is_cross):
+    meta = ctx.next_meta()
+    assert meta.is_cross == is_cross
+    assert not unet_mod.controller_touches(ctx.controller, meta), \
+        "experiment assumes identity controller"
+    b, pix, _ = x.shape
+    if is_cross:
+        q = nn_mod.linear(p["to_q"], x)
+        kv = context @ jnp.concatenate(
+            [p["to_k"]["kernel"], p["to_v"]["kernel"]], axis=1)
+        k, v = jnp.split(kv, 2, axis=-1)
+    else:
+        qkv = x @ jnp.concatenate(
+            [p["to_q"]["kernel"], p["to_k"]["kernel"], p["to_v"]["kernel"]],
+            axis=1)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+    d_head = q.shape[-1] // heads
+    scale = d_head ** -0.5
+    def split_heads(t):
+        return t.reshape(b, t.shape[1], heads, d_head).transpose(0, 2, 1, 3)
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    out = nn_mod.fused_attention(q, k, v, scale)
+    out = out.transpose(0, 2, 1, 3).reshape(b, pix, heads * d_head)
+    return nn_mod.linear(p["to_out"], out)
+unet_mod._apply_attention = attn_fused_qkv
+time_scan(4, "qkv-fused projections")
+unet_mod._apply_attention = orig_attn
+
 if "--all" not in sys.argv:
     sys.exit(0)
 
