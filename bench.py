@@ -106,28 +106,47 @@ _BENCH_RUNS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "bench_runs")
 
 
-def _load_last_onchip():
-    """Newest preserved on-chip measurement, or None.
+def _summarize_onchip(name, doc):
+    return {"metric": doc.get("metric"), "value": doc.get("value"),
+            "variant": doc.get("variant"),
+            "vs_baseline": doc.get("vs_baseline"),
+            # None for artifacts predating the platform gate (≤ r4).
+            "platform": doc.get("platform"),
+            "date": name.split("_", 1)[0], "artifact": f"bench_runs/{name}"}
+
+
+def _load_onchip_provenance():
+    """(newest, best) preserved on-chip measurements, or (None, None).
 
     The relay's healthy windows are scarce (multi-hour outages on both
     2026-07-30/31); when the driver's round-end run lands in an outage the
     fallback line must still carry honest, clearly-labeled provenance of the
-    last real chip measurement so "CPU fallback" is never mistaken for
-    "no TPU evidence" (VERDICT r3 weak #2)."""
+    real chip measurements so "CPU fallback" is never mistaken for
+    "no TPU evidence" (VERDICT r3 weak #2). Newest-only was understating:
+    a timeout-truncated run on a later day would shadow a stronger earlier
+    full sweep (ADVICE r4), so the best-by-headline artifact is surfaced
+    alongside the newest."""
     try:
-        names = sorted(n for n in os.listdir(_BENCH_RUNS)
-                       if n.endswith("_onchip.json"))
-        if not names:
-            return None
-        name = names[-1]
-        with open(os.path.join(_BENCH_RUNS, name)) as f:
-            doc = json.load(f)
-        return {"metric": doc.get("metric"), "value": doc.get("value"),
-                "variant": doc.get("variant"),
-                "vs_baseline": doc.get("vs_baseline"),
-                "date": name.split("_", 1)[0], "artifact": f"bench_runs/{name}"}
-    except (OSError, json.JSONDecodeError, IndexError):
-        return None
+        docs = []
+        for name in sorted(os.listdir(_BENCH_RUNS)):
+            if not name.endswith("_onchip.json"):
+                continue
+            try:
+                with open(os.path.join(_BENCH_RUNS, name)) as f:
+                    doc = json.load(f)
+                if isinstance(doc, dict) and isinstance(
+                        doc.get("value"), (int, float)):
+                    docs.append((name, doc))
+            except (OSError, json.JSONDecodeError):
+                continue
+        if not docs:
+            return None, None
+        newest = _summarize_onchip(*docs[-1])
+        best = _summarize_onchip(  # value ties break toward the newest
+            *max(docs, key=lambda nd: (nd[1].get("value") or 0.0, nd[0])))
+        return newest, best
+    except OSError:
+        return None, None
 
 
 def _archive_onchip(result):
@@ -135,7 +154,13 @@ def _archive_onchip(result):
     survives later outages; newest-wins filename keyed by UTC date. A
     same-day artifact is only replaced by a better-or-equal headline value
     (a later timeout-truncated run on a degrading lease must not clobber
-    the morning's full sweep)."""
+    the morning's full sweep), and replacement merges any metric keys the
+    new line lacks (a warm-cache re-run that skipped the secondaries must
+    not silently drop the morning's dpm/nullinv/config extras — ADVICE r4).
+    Lines whose measurement child did not verify a non-CPU jax platform are
+    never archived: on-chip provenance requires on-chip evidence."""
+    if result.get("platform") in (None, "cpu"):
+        return
     try:
         os.makedirs(_BENCH_RUNS, exist_ok=True)
         date = time.strftime("%Y-%m-%d", time.gmtime())
@@ -143,8 +168,17 @@ def _archive_onchip(result):
         if os.path.exists(path):
             try:
                 with open(path) as f:
-                    if json.load(f).get("value", 0) > result.get("value", 0):
-                        return
+                    existing = json.load(f)
+                if not (isinstance(existing, dict) and isinstance(
+                        existing.get("value"), (int, float))):
+                    existing = {}  # malformed artifact: replace it
+                if existing.get("value", 0) > result.get("value", 0):
+                    # Keep the better headline, but still absorb any metric
+                    # the worse run uniquely measured (e.g. a truncated
+                    # afternoon run that finally landed nullinv).
+                    result = {**result, **existing}
+                else:
+                    result = {**existing, **result}
             except (json.JSONDecodeError, OSError):
                 pass  # unreadable artifact: replace it
         with open(path, "w") as f:
@@ -245,9 +279,11 @@ def main():
     if str(result.get("metric", "")).startswith("sd14_"):
         _archive_onchip(result)
     else:
-        last = _load_last_onchip()
+        last, best = _load_onchip_provenance()
         if last is not None:
             result["last_onchip"] = last
+            if best["artifact"] != last["artifact"]:
+                result["best_onchip"] = best
     print(json.dumps(result))
     return 0
 
@@ -266,6 +302,18 @@ def _measure(preset):
     from p2p_tpu.models import SD14, TINY, init_text_encoder, init_unet
     from p2p_tpu.models import vae as vae_mod
     from p2p_tpu.utils.tokenizer import HashWordTokenizer
+
+    # The parent's probe and this child are separate backend inits: a PJRT
+    # plugin that fails init between them makes jax fall back to CPU with
+    # only a warning, and a CPU-measured sd14 line must never be printed
+    # (let alone archived) as on-chip evidence (ADVICE r4). The platform is
+    # re-verified here, embedded in every JSON line, and required non-CPU
+    # by _archive_onchip.
+    platform = jax.devices()[0].platform
+    if preset == "sd14" and platform == "cpu":
+        print("sd14 measurement refused: jax backend degraded to cpu "
+              "after the parent's accelerator probe", file=sys.stderr)
+        return 1
 
     t0 = time.monotonic()
     # Rehearsal disables the budget gates unconditionally (an inherited
@@ -343,6 +391,7 @@ def _measure(preset):
             "metric": metric,
             "value": round(best["value"], 4),
             "unit": "img/s/chip",
+            "platform": platform,
             # The baseline is defined for the SD-1.4 TPU workload; a
             # tiny-model CPU fallback rate is not comparable to it, so report
             # 0 rather than a meaningless (and flattering) ratio.
