@@ -137,7 +137,9 @@ def _load_onchip_provenance():
                 if isinstance(doc, dict) and isinstance(
                         doc.get("value"), (int, float)):
                     docs.append((name, doc))
-            except (OSError, json.JSONDecodeError):
+            # ValueError covers JSONDecodeError AND the UnicodeDecodeError a
+            # binary-corrupted artifact raises before JSON parsing starts.
+            except (OSError, ValueError):
                 continue
         if not docs:
             return None, None
@@ -179,7 +181,7 @@ def _archive_onchip(result):
                     result = {**result, **existing}
                 else:
                     result = {**existing, **result}
-            except (json.JSONDecodeError, OSError):
+            except (ValueError, OSError):  # incl. Unicode/JSON decode errors
                 pass  # unreadable artifact: replace it
         with open(path, "w") as f:
             json.dump(result, f)

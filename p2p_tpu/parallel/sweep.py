@@ -40,15 +40,16 @@ def _sweep_jit(
     latents: jax.Array,        # (G, B, h, w, c)
     controllers: Optional[Controller],   # leaves with leading G axis (or None)
     guidance_scale: jax.Array,
+    uncond_per_step: Optional[jax.Array],  # (G, T, 1, L, D) or None
 ):
-    def one_group(ctx, lat, ctrl):
+    def one_group(ctx, lat, ctrl, ups):
         lat, state = _denoise_scan(
             unet_params, cfg, layout, schedule, scheduler_kind, ctx, lat, ctrl,
-            guidance_scale)
+            guidance_scale, uncond_per_step=ups)
         image = vae_mod.decode(vae_params, cfg.vae, lat.astype(jnp.float32))
         return vae_mod.to_uint8(image), lat
 
-    return jax.vmap(one_group)(context, latents, controllers)
+    return jax.vmap(one_group)(context, latents, controllers, uncond_per_step)
 
 
 def sweep(
@@ -62,19 +63,44 @@ def sweep(
     scheduler: str = "ddim",
     layout: Optional[AttnLayout] = None,
     mesh: Optional[Mesh] = None,
+    uncond_per_step: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Run G independent edit groups; shard the group axis over ``dp``.
 
     ``context``: (G, 2B, L, D); ``latents``: (G, B, h, w, c);
     ``controllers``: a Controller pytree whose array leaves carry a leading
     G axis (same static structure per group — e.g. one edit with G equalizer
-    rows or G cross-window schedules), or None. Returns
+    rows or G cross-window schedules), or None.
+
+    ``uncond_per_step``: optional (G, T, 1, L, D) per-group null-text
+    embeddings (``InversionArtifact.uncond_embeddings`` stacked — or
+    broadcast — over the group axis), substituted for the uncond half of
+    ``context`` at each step exactly as in ``text2image``: an inverted real
+    image's edit sweep rides the same zero-collective dp engine as a seed
+    sweep (the missing-notebook workflow, `/root/reference/null_text.py:618`
+    + SURVEY §3.2, at mesh scale). DDIM-only, like the sequential path.
+    Negative-prompt contexts need no parameter here: the uncond rows of
+    ``context`` are caller-encoded, so a per-group negative prompt is just
+    a different uncond half. Returns
     ``(images (G,B,H,W,3) uint8, final latents)``.
     """
     cfg = pipe.config
     if layout is None:
         from ..models.config import unet_layout
         layout = unet_layout(cfg.unet)
+    if uncond_per_step is not None:
+        if scheduler != "ddim":
+            # Same constraint as text2image: the embeddings are optimized
+            # against the DDIM trajectory (`/root/reference/null_text.py:23`).
+            raise ValueError("uncond_per_step requires scheduler='ddim'")
+        if uncond_per_step.ndim != 5 or uncond_per_step.shape[0] != context.shape[0]:
+            raise ValueError(
+                f"uncond_per_step must be (G, T, 1, L, D) with G="
+                f"{context.shape[0]}, got {uncond_per_step.shape}")
+        if uncond_per_step.shape[1] != num_steps:
+            raise ValueError(
+                f"uncond_per_step has {uncond_per_step.shape[1]} steps, "
+                f"sampling uses {num_steps}")
     schedule = sched_mod.schedule_from_config(num_steps, cfg.scheduler,
                                               kind=scheduler)
     gs = jnp.asarray(guidance_scale, jnp.float32)
@@ -86,9 +112,12 @@ def sweep(
         if controllers is not None:
             controllers = jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, gspec), controllers)
+        if uncond_per_step is not None:
+            uncond_per_step = jax.device_put(uncond_per_step, gspec)
 
     return _sweep_jit(pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
-                      scheduler, context, latents, controllers, gs)
+                      scheduler, context, latents, controllers, gs,
+                      uncond_per_step)
 
 
 def seed_latents(rng: jax.Array, n_groups: int, group_batch: int,

@@ -217,3 +217,24 @@ def test_bench_rehearsal_green_and_complete():
     missing = EXPECTED_KEYS - set(doc)
     assert not missing, f"rehearsal line missing keys: {sorted(missing)}"
     assert doc["value"] > 0
+
+def test_onchip_provenance_survives_binary_corrupt_artifact(
+        tmp_path, monkeypatch):
+    # UnicodeDecodeError is not an OSError/JSONDecodeError; a garbled write
+    # must not break the one-JSON-line contract or lose a chip measurement.
+    bench = _import_bench()
+    monkeypatch.setattr(bench, "_BENCH_RUNS", str(tmp_path))
+    good = {"metric": "sd14_512_replace_edit_50step_imgs_per_s",
+            "value": 0.5, "variant": "single_group", "vs_baseline": 0.125,
+            "platform": "axon"}
+    with open(tmp_path / "2026-01-01_sd14_onchip.json", "w") as f:
+        json.dump(good, f)
+    with open(tmp_path / "2026-01-02_sd14_onchip.json", "wb") as f:
+        f.write(b"\xff\xfe\x00garbage")
+    newest, best = bench._load_onchip_provenance()
+    assert newest["value"] == 0.5 and best["value"] == 0.5
+    monkeypatch.setattr(bench.time, "gmtime", lambda: (2026, 1, 2, 0, 0, 0,
+                                                       0, 2, 0))
+    bench._archive_onchip(dict(good, value=0.6))
+    with open(tmp_path / "2026-01-02_sd14_onchip.json") as f:
+        assert json.load(f)["value"] == 0.6
