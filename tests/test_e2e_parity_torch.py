@@ -320,7 +320,7 @@ def _make_edit_hook(kind, mapper, cross_alpha, refine_alphas=None, eq_t=None,
 
 def _torch_cfg_sample(pipe, cfg, ctx, x_t, n_prompts, make_hook, guidance,
                       num_steps, vpred=False, timesteps=None, stepper=None,
-                      post_step=None):
+                      post_step=None, return_latents=False):
     """The reference sampling loop (`/root/reference/ptp_utils.py:65-76,
     129-172`) in torch: CFG batch-doubling, hooked U-Net, latent update, VAE
     decode, uint8 — returns the (B, H, W, 3) uint8 images.
@@ -332,7 +332,9 @@ def _torch_cfg_sample(pipe, cfg, ctx, x_t, n_prompts, make_hook, guidance,
     after the scheduler update (`controller.step_callback`,
     `/root/reference/ptp_utils.py:75`) — LocalBlend lives there.
     ``ctx`` may be a tensor or a ``step -> tensor`` callable (the null-text
-    replay substitutes a different uncond embedding every step)."""
+    replay substitutes a different uncond embedding every step).
+    ``return_latents=True`` returns the final latents and skips the VAE
+    decode (latent-space comparisons at expensive scales)."""
     acp, step_size, ddim_ts = _ddim_constants(cfg.scheduler, num_steps)
     if timesteps is None:
         timesteps = ddim_ts
@@ -360,6 +362,8 @@ def _torch_cfg_sample(pipe, cfg, ctx, x_t, n_prompts, make_hook, guidance,
                 latents = a_prev.sqrt() * x0 + (1 - a_prev).sqrt() * eps
             if post_step is not None:
                 latents = post_step(step, latents)
+        if return_latents:
+            return latents
         image = _torch_vae_decode(pipe.vae_params, cfg.vae, latents)
     img = (image.permute(0, 2, 3, 1) / 2 + 0.5).clamp(0, 1).numpy()
     return (img * 255).astype(np.uint8)
@@ -930,3 +934,84 @@ def test_replay_with_null_embeddings_matches_torch_pipeline():
     assert diff.max() <= 1, (
         f"max pixel diff {diff.max()}, mean {diff.mean():.4f}")
     assert diff.mean() < 0.05
+
+
+def test_text2image_short_loop_matches_torch_at_sd14_scale():
+    """The loop × scale seam (VERDICT r4 missing #2): the controlled CFG
+    sampling loop at the REAL SD-1.4 topology (860M-param U-Net, 64² latent,
+    77×768 context) for 2 steps, ours vs the torch reference loop — scan
+    carry dtypes, scheduler constants, and controller gather shapes at real
+    shapes, composing the families `test_full_*_sd14_scale` (full scale, one
+    forward) and `test_text2image_matches_torch_pipeline` (full loop, tiny)
+    left separate. Latent-space comparison through a jitted
+    `_denoise_scan` — the exact scan program both `text2image` and the dp
+    sweep compile — with no VAE decode on either side: the 512² decode is
+    covered at full scale by
+    `test_full_vae_matches_torch_oracle_sd14_scale`."""
+    from p2p_tpu.engine.sampler import _denoise_scan
+    from p2p_tpu.models.config import SD14, unet_layout
+    from p2p_tpu.ops import schedulers as _sched
+
+    cfg = SD14
+    steps = 2
+    tok = HashWordTokenizer(model_max_length=cfg.text.max_length)
+    L = cfg.unet.context_len
+    prompts = PROMPTS_BY_MODE["replace"]
+    pipe = Pipeline(
+        config=cfg,
+        unet_params=init_unet(jax.random.PRNGKey(30), cfg.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(31), cfg.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(32), cfg.vae),
+        tokenizer=tok,
+    )
+    x_t = jax.random.normal(jax.random.PRNGKey(33),
+                            (1,) + pipe.latent_shape, jnp.float32)
+
+    controller = factory.attention_replace(
+        prompts, steps, cross_replace_steps=CROSS_REPLACE,
+        self_replace_steps=SELF_REPLACE, tokenizer=tok,
+        self_max_pixels=SELF_MAX_PIXELS, max_len=L)
+
+    # --- ours: the jitted loop at full scale, final latents out ----------
+    from p2p_tpu.engine.sampler import encode_prompts as _enc
+
+    n = len(prompts)
+    ctx_c = _enc(pipe, prompts)
+    ctx_u = _enc(pipe, [""] * n)
+    ctx = jnp.concatenate([ctx_u, ctx_c], axis=0)
+    lats0 = jnp.broadcast_to(x_t, (n,) + x_t.shape[1:])
+    layout = unet_layout(cfg.unet)
+    schedule = _sched.schedule_from_config(steps, cfg.scheduler, kind="ddim")
+
+    @jax.jit
+    def run_scan(p, c, lat, ctrl, gs):
+        lat, _ = _denoise_scan(p, cfg, layout, schedule, "ddim", c, lat,
+                               ctrl, gs)
+        return lat
+
+    got_final = np.asarray(run_scan(pipe.unet_params, ctx, lats0, controller,
+                                    jnp.float32(GUIDANCE)))
+
+    # --- torch: the reference loop at the same scale, no decode ----------
+    ref_ptp, ref_aligner = _reference_modules()
+    mapper = ref_aligner.get_replacement_mapper(prompts, tok,
+                                                max_len=L).float()
+    cross_alpha = ref_ptp.get_time_words_attention_alpha(
+        prompts, steps, CROSS_REPLACE, tok, max_num_words=L).float()
+    make_hook = _make_edit_hook(
+        "replace", mapper, cross_alpha,
+        self_window=(0, int(steps * SELF_REPLACE)))
+
+    enc = _torch_text_encode(cfg, pipe.text_params, tok,
+                             list(prompts) + [""] * n)
+    ctx_t = torch.cat([enc[n:], enc[:n]], dim=0)
+
+    want_final = _torch_cfg_sample(
+        pipe, cfg, ctx_t, x_t, n, make_hook, GUIDANCE, steps,
+        return_latents=True).permute(0, 2, 3, 1).numpy()
+
+    # Two full-scale CFG steps compound the single-forward f32 drift
+    # (atol 2e-4 at one forward, guidance 7.5 amplifies the eps delta).
+    np.testing.assert_allclose(got_final, want_final, atol=5e-3, rtol=1e-2)
+    # And the trajectory is genuinely edited + controlled, not degenerate.
+    assert not np.allclose(got_final[0], got_final[1])
