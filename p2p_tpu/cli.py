@@ -156,16 +156,23 @@ def _group_setup(pipe, prompts, seeds, negative_prompt):
                                         (1,) + pipe.latent_shape)
                       for s in seeds])
     lats = jnp.broadcast_to(base, (g, len(prompts)) + pipe.latent_shape)
-    # Shard over the largest divisor of g that fits the visible devices
-    # (g=6 on 4 devices rides 3, not 1); say so when parallelism degrades,
-    # rather than silently losing what --batch-seeds advertises.
+    return ctx, lats, _dp_mesh(g, f"--batch-seeds: {g} seeds")
+
+
+def _dp_mesh(g, what):
+    """Shard over the largest divisor of g that fits the visible devices
+    (g=6 on 4 devices rides 3, not 1); say so when parallelism degrades,
+    rather than silently losing what the batch flag advertises."""
+    import jax
+
+    from .parallel import make_mesh
+
     cap = min(len(jax.devices()), g)
     n_dev = max((d for d in range(1, cap + 1) if g % d == 0), default=1)
     if n_dev < cap:
-        print(f"--batch-seeds: {g} seeds not divisible by {cap} devices; "
+        print(f"{what} not divisible by {cap} devices; "
               f"sharding over {n_dev}", file=sys.stderr)
-    mesh = make_mesh(n_dev) if n_dev > 1 else None
-    return ctx, lats, mesh
+    return make_mesh(n_dev) if n_dev > 1 else None
 
 
 def _edit_batched(args, pipe, prompts, controller, out_dir) -> int:
@@ -293,24 +300,79 @@ def cmd_replay(args) -> int:
 
     from .engine.inversion import InversionArtifact
     from .engine.sampler import text2image
-
-    pipe = _build_pipeline(args)
-    art = InversionArtifact.load(args.artifact)
-    prompts = [art.prompt, args.target] if args.target else [art.prompt]
-    controller = (None if len(prompts) == 1 else
-                  _make_controller(args, prompts, pipe.tokenizer, art.num_steps))
     from .utils.progress import trace
 
-    with trace(args.profile):
-        img, _, _ = text2image(
-            pipe, prompts, controller, num_steps=art.num_steps,
-            guidance_scale=args.guidance, latent=jnp.asarray(art.x_t),
-            uncond_embeddings=jnp.asarray(art.uncond_embeddings),
-            progress=not args.quiet)
+    targets = args.target or []
+    if args.batch_targets and not targets:
+        raise SystemExit("--batch-targets needs at least one --target")
+    pipe = _build_pipeline(args)
+    art = InversionArtifact.load(args.artifact)
     out_dir = args.out_dir or "outputs"
-    _save(np.asarray(img[0]), os.path.join(out_dir, "reconstruction.png"))
-    if len(prompts) > 1:
-        _save(np.asarray(img[1]), os.path.join(out_dir, "edited.png"))
+
+    def edited_path(i):
+        return os.path.join(
+            out_dir, "edited.png" if len(targets) == 1
+            else f"edited_{i:02d}.png")
+
+    if args.batch_targets:
+        return _replay_batched(args, pipe, art, targets, out_dir, edited_path)
+
+    x_t = jnp.asarray(art.x_t)
+    ups = jnp.asarray(art.uncond_embeddings)
+    with trace(args.profile):
+        for i, target in enumerate(targets or [None]):
+            prompts = [art.prompt, target] if target else [art.prompt]
+            controller = (None if target is None else _make_controller(
+                args, prompts, pipe.tokenizer, art.num_steps))
+            img, _, _ = text2image(
+                pipe, prompts, controller, num_steps=art.num_steps,
+                guidance_scale=args.guidance, latent=x_t,
+                uncond_embeddings=ups, progress=not args.quiet)
+            if i == 0:
+                _save(np.asarray(img[0]),
+                      os.path.join(out_dir, "reconstruction.png"))
+            if target is not None:
+                _save(np.asarray(img[1]), edited_path(i))
+    return 0
+
+
+def _replay_batched(args, pipe, art, targets, out_dir, edited_path) -> int:
+    """All target edits of one inversion artifact as ONE compiled dp-swept
+    program: each group is [source, target_i] with the artifact's per-step
+    null embeddings broadcast over groups — the missing-notebook workflow
+    (`/root/reference/null_text.py:618` + SURVEY §3.2) at sweep throughput.
+    Target controllers are traced leaves of one stacked pytree, so they must
+    share structure: one --mode/--blend-words/--equalizer for all targets."""
+    import jax
+    import jax.numpy as jnp
+
+    from .engine.sampler import encode_prompts
+    from .parallel import sweep
+    from .utils.progress import trace
+
+    g = len(targets)
+    ctrl_list = [_make_controller(args, [art.prompt, t], pipe.tokenizer,
+                                  art.num_steps) for t in targets]
+    ctrls = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ctrl_list)
+    # One text-encoder forward for everything: "", source, then the targets.
+    enc = encode_prompts(pipe, ["", art.prompt] + list(targets))
+    uncond, source = enc[0], enc[1]
+    ctx_g = jnp.stack([
+        jnp.stack([uncond, uncond, source, enc[2 + i]])
+        for i in range(g)])
+    x_t = jnp.asarray(art.x_t)
+    lats = jnp.broadcast_to(x_t[None], (g, 2) + x_t.shape[1:])
+    ups = jnp.broadcast_to(jnp.asarray(art.uncond_embeddings)[None],
+                           (g,) + art.uncond_embeddings.shape)
+    with trace(args.profile):
+        imgs, _ = sweep(pipe, ctx_g, lats, ctrls, num_steps=art.num_steps,
+                        guidance_scale=args.guidance,
+                        mesh=_dp_mesh(g, f"--batch-targets: {g} targets"),
+                        uncond_per_step=ups)
+        imgs = np.asarray(imgs)
+    _save(imgs[0][0], os.path.join(out_dir, "reconstruction.png"))
+    for i in range(g):
+        _save(imgs[i][1], edited_path(i))
     return 0
 
 
@@ -414,9 +476,16 @@ def build_parser() -> argparse.ArgumentParser:
     r = sub.add_parser("replay", help="edit a previously inverted image")
     model_opts(r); edit_opts(r)
     r.add_argument("--artifact", required=True)
-    r.add_argument("--target", default=None,
-                   help="edited prompt (omit for pure reconstruction)")
+    r.add_argument("--target", action="append", default=None,
+                   help="edited prompt; repeatable for a target sweep "
+                        "(omit for pure reconstruction)")
     r.add_argument("--out-dir", default=None)
+    r.add_argument("--batch-targets", action="store_true",
+                   help="run all --target edits of the artifact as one "
+                        "batched program through the dp sweep engine "
+                        "(one edit group per target, sharded over the mesh; "
+                        "all targets share --mode/--blend-words/--equalizer; "
+                        "no per-step progress output in batched mode)")
     r.set_defaults(fn=cmd_replay)
 
     c = sub.add_parser(

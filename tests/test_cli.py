@@ -108,6 +108,20 @@ def test_invert_then_replay(tmp_path):
     assert os.path.exists(os.path.join(out_dir, "reconstruction.png"))
     assert os.path.exists(os.path.join(out_dir, "edited.png"))
 
+    # --batch-targets: a multi-target edit sweep of the same artifact rides
+    # the dp sweep engine (one program, per-step null embeddings broadcast
+    # over groups) and matches the sequential replay per target.
+    bat_dir = os.path.join(tmp_path, "replay_batch")
+    assert main(["replay", "--quiet", "--artifact", art, "--target", "a dog",
+                 "--target", "a fox", "--mode", "replace",
+                 "--batch-targets", "--out-dir", bat_dir]) == 0
+    assert os.path.exists(os.path.join(bat_dir, "reconstruction.png"))
+    assert os.path.exists(os.path.join(bat_dir, "edited_01.png"))
+    seq = np.asarray(Image.open(os.path.join(out_dir, "edited.png")), np.int32)
+    bat = np.asarray(Image.open(os.path.join(bat_dir, "edited_00.png")),
+                     np.int32)
+    assert np.abs(seq - bat).max() <= 1
+
 
 def test_rejected_unknown_flag():
     with pytest.raises(SystemExit):

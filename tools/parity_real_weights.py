@@ -16,7 +16,8 @@ Writes both images plus `report.json` with a per-stage max-abs breakdown:
     text_encoder   last_hidden_state, ours vs torch tower
     unet_eps       one CFG U-Net forward at the first timestep
     loop_latent    final latent after the full controlled sampling loop
-    vae_decode     decode of OUR final latent through both VAEs (f32 image)
+    vae_decode     the torch loop's final latent decoded through both VAEs
+                   (f32 image — isolates the decoder from loop drift)
     image          final uint8 images (max + mean pixel diff)
 
 Exit 0 iff the uint8 images agree within one quantization level — the
@@ -176,11 +177,12 @@ def main(argv=None):
             import ptp_utils as ref_ptp
             import seq_aligner as ref_aligner
 
-            mapper = ref_aligner.get_replacement_mapper(
+            m = ref_aligner.get_replacement_mapper(
                 prompts, tok, max_len=L).float()
-            cross_alpha = ref_ptp.get_time_words_attention_alpha(
+            a = ref_ptp.get_time_words_attention_alpha(
                 prompts, steps, args.cross_replace, tok,
                 max_num_words=L).float()
+            mapper, cross_alpha = m, a  # atomic: both or fall back to ours
             report["edit_precompute"] = "reference"
         except Exception as e:
             print(f"  (reference precompute unavailable: {e})", flush=True)
@@ -202,21 +204,16 @@ def main(argv=None):
         "replace", mapper, cross_alpha,
         self_window=(0, int(steps * args.self_replace)))
 
-    acp, step_size, _ = O._ddim_constants(cfg.scheduler, steps)
     final_lat = {}
 
-    def capture_stepper(step, t, eps, latents):
-        a_t = acp[t]
-        prev_t = t - step_size
-        a_prev = acp[prev_t] if prev_t >= 0 else acp[0]
-        x0 = (latents - (1 - a_t).sqrt() * eps) / a_t.sqrt()
-        latents = a_prev.sqrt() * x0 + (1 - a_prev).sqrt() * eps
+    def capture_post_step(step, latents):
+        # Runs after the helper's own (unduplicated) DDIM update.
         final_lat["lat"] = latents
         return latents
 
     torch_img = O._torch_cfg_sample(
         pipe, cfg, ctx_torch, x_t, n, make_hook, guidance, steps,
-        vpred=vpred, stepper=capture_stepper)
+        vpred=vpred, post_step=capture_post_step)
 
     torch_final = final_lat["lat"]
     stage("loop_latent", ours_final,
