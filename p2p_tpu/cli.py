@@ -32,15 +32,21 @@ from typing import List, Optional
 import numpy as np
 
 
+def _preset_config(name):
+    from .models.config import PRESET_CONFIGS
+
+    return PRESET_CONFIGS[name]
+
+
 def _build_pipeline(args):
     import jax
 
     from .engine.sampler import Pipeline
-    from .models import LDM256, SD14, TINY, init_text_encoder, init_unet
+    from .models import init_text_encoder, init_unet
     from .models import vae as vae_mod
     from .utils.tokenizer import HashWordTokenizer
 
-    cfg = {"tiny": TINY, "sd14": SD14, "ldm256": LDM256}[args.preset]
+    cfg = _preset_config(args.preset)
     if args.checkpoint:
         from .models.checkpoint import load_pipeline
 
@@ -397,8 +403,14 @@ def build_parser() -> argparse.ArgumentParser:
     # accepted-but-ignored options (the reference's unread `--path
     # config.yaml`, `/root/reference/main.py:388`, is the anti-pattern).
     def model_opts(sp):
-        sp.add_argument("--preset", choices=("tiny", "sd14", "ldm256"),
-                        default="tiny")
+        from .models.config import PRESET_CONFIGS
+
+        sp.add_argument("--preset", choices=tuple(PRESET_CONFIGS),
+                        default="tiny",
+                        help="model family; sd21 is the 768-v v-prediction "
+                             "variant the reference marks 'Not work' "
+                             "(`/root/reference/main.py:27`) — supported "
+                             "here")
         sp.add_argument("--checkpoint", default=None,
                         help="diffusers-format checkpoint dir (unet/ vae/ ...)")
         sp.add_argument("--guidance", type=float, default=7.5)
@@ -488,11 +500,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "no per-step progress output in batched mode)")
     r.set_defaults(fn=cmd_replay)
 
+    from .models.checkpoint_check import PRESETS as CHECK_PRESETS
+
     c = sub.add_parser(
         "check", help="checkpoint-readiness report (no weights loaded)")
     c.add_argument("checkpoint_dir")
-    c.add_argument("--preset", required=True,
-                   choices=("sd14", "sd21", "sd21base", "ldm256"))
+    c.add_argument("--preset", required=True, choices=CHECK_PRESETS)
     c.set_defaults(fn=cmd_check)
     return p
 

@@ -227,22 +227,28 @@ def _check_tokenizer(dirpath: str, arch: str) -> Optional[str]:
 # Entry point
 # ---------------------------------------------------------------------------
 
-PRESETS = ("sd14", "sd21", "sd21base", "ldm256")
+def _real_presets():
+    # tiny* presets exist for tests (synthetic checkpoints pass config=);
+    # the readiness report targets real released directories.
+    from .config import PRESET_CONFIGS
+
+    return tuple(k for k in PRESET_CONFIGS if not k.startswith("tiny"))
+
+
+PRESETS = _real_presets()
 
 
 def check_checkpoint(dirpath: str, preset: str, config=None) -> Report:
     """``config`` overrides the preset's PipelineConfig (tests use tiny
     configs against synthetic checkpoint dirs)."""
-    from . import config as cfg_mod
     from . import vae as vae_mod
     from .checkpoint import (ldm_text_encoder_entries, text_encoder_entries,
                              unet_entries, vae_entries)
+    from .config import PRESET_CONFIGS
     from .text_encoder import init_text_encoder
     from .unet import init_unet
 
-    cfg = config if config is not None else {
-        "sd14": cfg_mod.SD14, "sd21": cfg_mod.SD21,
-        "sd21base": cfg_mod.SD21_BASE, "ldm256": cfg_mod.LDM256}[preset]
+    cfg = config if config is not None else PRESET_CONFIGS[preset]
     text_entries = (ldm_text_encoder_entries(cfg.text)
                     if cfg.text.arch == "ldmbert"
                     else text_encoder_entries(cfg.text))

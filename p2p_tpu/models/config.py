@@ -290,3 +290,16 @@ TINY_LDM = PipelineConfig("tiny-ldm", TINY_LDM_UNET, TINY_LDM_TEXT,
                           scheduler=SchedulerConfig(
                               beta_start=0.0015, beta_end=0.0195,
                               plms_steps_offset=0))
+
+
+# The one preset-name → PipelineConfig map. Every user-facing preset choice
+# (CLI model_opts, `p2p-tpu check`, tools/parity_real_weights.py) derives
+# from this dict so a new preset is added in exactly one place.
+PRESET_CONFIGS = {
+    "tiny": TINY,
+    "sd14": SD14,
+    "sd21": SD21,
+    "sd21base": SD21_BASE,
+    "ldm256": LDM256,
+    "tiny_ldm": TINY_LDM,
+}

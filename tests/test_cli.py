@@ -147,3 +147,28 @@ def test_group_setup_shards_over_largest_divisor(tiny_pipe, capsys):
     _, _, mesh8 = _group_setup(tiny_pipe, ["a cat"], list(range(8)), None)
     assert mesh8.devices.size == 8
     assert "sharding over" not in capsys.readouterr().err
+
+
+def test_every_cli_preset_resolves_to_a_config():
+    """Every preset choice (generate/edit/..., and `check`) derives from the
+    one PRESET_CONFIGS map — includes sd21/sd21base (the v-prediction family
+    the reference marks 'Not work', `/root/reference/main.py:27`)."""
+    from p2p_tpu.cli import _preset_config, build_parser
+    from p2p_tpu.models.checkpoint_check import PRESETS as CHECK_PRESETS
+    from p2p_tpu.models.config import PRESET_CONFIGS
+
+    parser = build_parser()
+    subs = parser._subparsers._group_actions[0].choices
+    gen = next(a for a in subs["generate"]._actions
+               if "--preset" in a.option_strings)
+    assert set(gen.choices) == set(PRESET_CONFIGS)
+    assert {"sd21", "sd21base"} <= set(gen.choices)
+    chk = next(a for a in subs["check"]._actions
+               if "--preset" in a.option_strings)
+    assert set(chk.choices) == set(CHECK_PRESETS)
+    assert set(CHECK_PRESETS) == {k for k in PRESET_CONFIGS
+                                  if not k.startswith("tiny")}
+    for name in gen.choices:
+        assert _preset_config(name).name
+    # sd21 is the v-prediction variant.
+    assert _preset_config("sd21").scheduler.prediction_type == "v_prediction"

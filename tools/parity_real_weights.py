@@ -39,13 +39,13 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 
 
 def main(argv=None):
+    from p2p_tpu.models.config import PRESET_CONFIGS
+
     ap = argparse.ArgumentParser(
         description="Per-stage parity of a real checkpoint vs the torch "
                     "reference loop")
     ap.add_argument("checkpoint", help="diffusers-format checkpoint dir")
-    ap.add_argument("--preset", default="sd14",
-                    choices=("sd14", "sd21", "sd21base", "ldm256", "tiny",
-                             "tiny_ldm"))
+    ap.add_argument("--preset", default="sd14", choices=tuple(PRESET_CONFIGS))
     ap.add_argument("--prompts", nargs=2,
                     default=["a squirrel eating a burger",
                              "a squirrel eating a lasagna"],
@@ -76,7 +76,6 @@ def main(argv=None):
     from PIL import Image
 
     from p2p_tpu.controllers import factory
-    from p2p_tpu.models import config as cfg_mod
     from p2p_tpu.models.checkpoint import load_pipeline
     from p2p_tpu.models.unet import apply_unet
     from p2p_tpu.models import vae as vae_mod
@@ -87,9 +86,7 @@ def main(argv=None):
     import test_e2e_parity_torch as O
     torch = O.torch
 
-    cfg = {"sd14": cfg_mod.SD14, "sd21": cfg_mod.SD21,
-           "sd21base": cfg_mod.SD21_BASE, "ldm256": cfg_mod.LDM256,
-           "tiny": cfg_mod.TINY, "tiny_ldm": cfg_mod.TINY_LDM}[args.preset]
+    cfg = PRESET_CONFIGS[args.preset]
     guidance = cfg.guidance_scale if args.guidance is None else args.guidance
     prompts = list(args.prompts)
     steps = args.steps
