@@ -7,7 +7,9 @@ stops at returning the inversion, this completes the loop the notebook held):
 2. optimize a per-step null (uncond) embedding so full-guidance CFG sampling
    reproduces the image,
 3. persist the artifact,
-4. replay with an edit controller to edit the real image.
+4. replay with an edit controller to edit the real image,
+5. sweep several target edits of the SAME artifact as one dp-batched
+   program (`sweep(uncond_per_step=...)` — pass --target repeatedly).
 
     python examples/null_text_w_ptp.py --preset tiny --image cat.png \
         --prompt "a cat sitting next to a mirror" --target "a tiger sitting next to a mirror"
@@ -32,9 +34,13 @@ def main():
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--image", default=None)
     ap.add_argument("--prompt", default="a cat sitting next to a mirror")
-    ap.add_argument("--target", default="a tiger sitting next to a mirror")
+    ap.add_argument("--target", action="append", default=None,
+                    help="edit prompt; repeatable — extra targets ride one "
+                         "dp-batched sweep of the same artifact")
     ap.add_argument("--out-dir", default="outputs/null_text")
     args = ap.parse_args()
+    targets = args.target or ["a tiger sitting next to a mirror",
+                              "a lion sitting next to a mirror"]
 
     from p2p_tpu.controllers import factory
     from p2p_tpu.engine.inversion import InversionArtifact, invert, load_image
@@ -65,18 +71,46 @@ def main():
 
     # 3: reload (proving the artifact round-trips) and 4: edit-replay.
     art = InversionArtifact.load(art_path)
-    prompts = [art.prompt, args.target]
-    ctrl = factory.attention_replace(
-        prompts, art.num_steps, cross_replace_steps=0.8,
-        self_replace_steps=0.4, tokenizer=pipe.tokenizer,
-        max_len=pipe.config.text.max_length)
+
+    def make_ctrl(target):
+        return factory.attention_replace(
+            [art.prompt, target], art.num_steps, cross_replace_steps=0.8,
+            self_replace_steps=0.4, tokenizer=pipe.tokenizer,
+            max_len=pipe.config.text.max_length)
+
+    prompts = [art.prompt, targets[0]]
     imgs, _, _ = text2image(
-        pipe, prompts, ctrl, num_steps=art.num_steps,
+        pipe, prompts, make_ctrl(targets[0]), num_steps=art.num_steps,
         latent=jnp.asarray(art.x_t),
         uncond_embeddings=jnp.asarray(art.uncond_embeddings), progress=True)
     viz.view_images(np.asarray(imgs),
                     save_path=os.path.join(args.out_dir, "reconstruction_and_edit.png"))
     print(f"wrote {args.out_dir}/reconstruction_and_edit.png")
+
+    # 5: every target edit of the one artifact as ONE dp-batched program —
+    # the sweep the reference's sequential notebook loop could never run
+    # (its per-edit cost was a fresh 50-step sampling pass each time).
+    if len(targets) > 1:
+        import jax
+
+        from p2p_tpu.parallel import artifact_replay_inputs, make_mesh, sweep
+
+        g = len(targets)
+        ctx_g, lats, ups, ctrls = artifact_replay_inputs(
+            pipe, art.x_t, art.uncond_embeddings, art.prompt, targets,
+            [make_ctrl(t) for t in targets])
+        n_dev = max((d for d in range(1, min(len(jax.devices()), g) + 1)
+                     if g % d == 0), default=1)
+        mesh = make_mesh(n_dev) if n_dev > 1 else None
+        swept, _ = sweep(pipe, ctx_g, lats, ctrls, num_steps=art.num_steps,
+                         mesh=mesh, uncond_per_step=ups)
+        grid = np.concatenate([np.asarray(swept[:1, 0]),
+                               np.asarray(swept[:, 1])])
+        viz.view_images(grid,
+                        save_path=os.path.join(args.out_dir,
+                                               "target_sweep.png"))
+        print(f"wrote {args.out_dir}/target_sweep.png "
+              f"(reconstruction + {g} target edits, one compiled program)")
     return 0
 
 

@@ -14,10 +14,12 @@ whole edit API is on the command line:
     python -m p2p_tpu.cli replay --artifact cat_inv.npz \
         --target "a tiger" --mode replace --out-dir logs/replay
 
-Presets: ``tiny`` (random weights, fast — the default when no checkpoint is
-given), ``sd14``/``ldm256`` (SD-1.4 / LDM-256 shapes; random weights unless
-``--checkpoint`` points at a diffusers-format directory). Every edit run
-writes the baseline/edited pair like `run_and_display`
+Presets: ``tiny``/``tiny_ldm`` (random weights, fast — ``tiny`` is the
+default when no checkpoint is given), ``sd14``/``sd21``/``sd21base``/
+``ldm256`` (real model shapes; random weights unless ``--checkpoint``
+points at a diffusers-format directory; ``sd21`` is the 768-v v-prediction
+family the reference marks "Not work"). Every edit run writes the
+baseline/edited pair like `run_and_display`
 (`/root/reference/main.py:353-383`).
 """
 
@@ -349,27 +351,14 @@ def _replay_batched(args, pipe, art, targets, out_dir, edited_path) -> int:
     (`/root/reference/null_text.py:618` + SURVEY §3.2) at sweep throughput.
     Target controllers are traced leaves of one stacked pytree, so they must
     share structure: one --mode/--blend-words/--equalizer for all targets."""
-    import jax
-    import jax.numpy as jnp
-
-    from .engine.sampler import encode_prompts
-    from .parallel import sweep
+    from .parallel import artifact_replay_inputs, sweep
     from .utils.progress import trace
 
     g = len(targets)
     ctrl_list = [_make_controller(args, [art.prompt, t], pipe.tokenizer,
                                   art.num_steps) for t in targets]
-    ctrls = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ctrl_list)
-    # One text-encoder forward for everything: "", source, then the targets.
-    enc = encode_prompts(pipe, ["", art.prompt] + list(targets))
-    uncond, source = enc[0], enc[1]
-    ctx_g = jnp.stack([
-        jnp.stack([uncond, uncond, source, enc[2 + i]])
-        for i in range(g)])
-    x_t = jnp.asarray(art.x_t)
-    lats = jnp.broadcast_to(x_t[None], (g, 2) + x_t.shape[1:])
-    ups = jnp.broadcast_to(jnp.asarray(art.uncond_embeddings)[None],
-                           (g,) + art.uncond_embeddings.shape)
+    ctx_g, lats, ups, ctrls = artifact_replay_inputs(
+        pipe, art.x_t, art.uncond_embeddings, art.prompt, targets, ctrl_list)
     with trace(args.profile):
         imgs, _ = sweep(pipe, ctx_g, lats, ctrls, num_steps=art.num_steps,
                         guidance_scale=args.guidance,
@@ -403,9 +392,13 @@ def build_parser() -> argparse.ArgumentParser:
     # accepted-but-ignored options (the reference's unread `--path
     # config.yaml`, `/root/reference/main.py:388`, is the anti-pattern).
     def model_opts(sp):
-        from .models.config import PRESET_CONFIGS
-
-        sp.add_argument("--preset", choices=tuple(PRESET_CONFIGS),
+        # Literal name tuples: build_parser must stay jax-free so --help and
+        # argparse errors are instant. Drift against the canonical
+        # PRESET_CONFIGS map is pinned by
+        # tests/test_cli.py::test_every_cli_preset_resolves_to_a_config.
+        sp.add_argument("--preset",
+                        choices=("tiny", "sd14", "sd21", "sd21base",
+                                 "ldm256", "tiny_ldm"),
                         default="tiny",
                         help="model family; sd21 is the 768-v v-prediction "
                              "variant the reference marks 'Not work' "
@@ -500,12 +493,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "no per-step progress output in batched mode)")
     r.set_defaults(fn=cmd_replay)
 
-    from .models.checkpoint_check import PRESETS as CHECK_PRESETS
-
     c = sub.add_parser(
         "check", help="checkpoint-readiness report (no weights loaded)")
     c.add_argument("checkpoint_dir")
-    c.add_argument("--preset", required=True, choices=CHECK_PRESETS)
+    c.add_argument("--preset", required=True,
+                   choices=("sd14", "sd21", "sd21base", "ldm256"))
     c.set_defaults(fn=cmd_check)
     return p
 

@@ -4,9 +4,9 @@ from .alltoall import alltoall_self_attention
 from .mesh import data_sharding, make_mesh, param_specs, shard_params
 from .multihost import global_mesh, initialize, process_groups
 from .ring import ring_self_attention, sp_sharding
-from .sweep import seed_latents, sweep
+from .sweep import artifact_replay_inputs, seed_latents, sweep
 
-__all__ = ["alltoall_self_attention", "data_sharding", "global_mesh",
-           "initialize", "make_mesh", "param_specs", "process_groups",
-           "ring_self_attention", "shard_params", "seed_latents",
-           "sp_sharding", "sweep"]
+__all__ = ["alltoall_self_attention", "artifact_replay_inputs",
+           "data_sharding", "global_mesh", "initialize", "make_mesh",
+           "param_specs", "process_groups", "ring_self_attention",
+           "shard_params", "seed_latents", "sp_sharding", "sweep"]

@@ -120,6 +120,34 @@ def sweep(
                       uncond_per_step)
 
 
+def artifact_replay_inputs(pipe, x_t, uncond_embeddings, source: str,
+                           targets, controllers):
+    """Build the ``sweep`` inputs that replay one inversion artifact across
+    G target edits: ``(ctx_g, lats, ups, ctrls)``.
+
+    ``x_t``/``uncond_embeddings``/``source`` come from an
+    ``InversionArtifact``; ``controllers`` is one Controller per target
+    (same static structure — one edit mode for all). One text-encoder
+    forward covers every prompt; the terminal latent and per-step null
+    embeddings broadcast over the group axis. Shared by
+    ``p2p-tpu replay --batch-targets`` and
+    ``examples/null_text_w_ptp.py`` step 5."""
+    from ..engine.sampler import encode_prompts
+
+    g = len(targets)
+    if len(controllers) != g:
+        raise ValueError(f"{len(controllers)} controllers for {g} targets")
+    ctrls = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *controllers)
+    enc = encode_prompts(pipe, ["", source] + list(targets))
+    ctx_g = jnp.stack([jnp.stack([enc[0], enc[0], enc[1], enc[2 + i]])
+                       for i in range(g)])
+    x_t = jnp.asarray(x_t)
+    lats = jnp.broadcast_to(x_t[None], (g, 2) + x_t.shape[1:])
+    ups = jnp.broadcast_to(jnp.asarray(uncond_embeddings)[None],
+                           (g,) + tuple(uncond_embeddings.shape))
+    return ctx_g, lats, ups, ctrls
+
+
 def seed_latents(rng: jax.Array, n_groups: int, group_batch: int,
                  shape: Tuple[int, int, int], dtype=jnp.float32) -> jax.Array:
     """One shared latent per group, expanded over the group's prompt batch
