@@ -7,7 +7,8 @@ stops at returning the inversion, this completes the loop the notebook held):
 2. optimize a per-step null (uncond) embedding so full-guidance CFG sampling
    reproduces the image,
 3. persist the artifact,
-4. replay with an edit controller to edit the real image,
+4. replay with an edit controller to edit the real image (single-target
+   runs; with several targets the sweep below already covers it),
 5. sweep several target edits of the SAME artifact as one dp-batched
    program (`sweep(uncond_per_step=...)` — pass --target repeatedly).
 
@@ -78,18 +79,23 @@ def main():
             self_replace_steps=0.4, tokenizer=pipe.tokenizer,
             max_len=pipe.config.text.max_length)
 
-    prompts = [art.prompt, targets[0]]
-    imgs, _, _ = text2image(
-        pipe, prompts, make_ctrl(targets[0]), num_steps=art.num_steps,
-        latent=jnp.asarray(art.x_t),
-        uncond_embeddings=jnp.asarray(art.uncond_embeddings), progress=True)
-    viz.view_images(np.asarray(imgs),
-                    save_path=os.path.join(args.out_dir, "reconstruction_and_edit.png"))
-    print(f"wrote {args.out_dir}/reconstruction_and_edit.png")
+    if len(targets) == 1:
+        prompts = [art.prompt, targets[0]]
+        imgs, _, _ = text2image(
+            pipe, prompts, make_ctrl(targets[0]), num_steps=art.num_steps,
+            latent=jnp.asarray(art.x_t),
+            uncond_embeddings=jnp.asarray(art.uncond_embeddings),
+            progress=True)
+        viz.view_images(np.asarray(imgs),
+                        save_path=os.path.join(args.out_dir,
+                                               "reconstruction_and_edit.png"))
+        print(f"wrote {args.out_dir}/reconstruction_and_edit.png")
 
     # 5: every target edit of the one artifact as ONE dp-batched program —
     # the sweep the reference's sequential notebook loop could never run
     # (its per-edit cost was a fresh 50-step sampling pass each time).
+    # Group 0 already contains the reconstruction + first edit, so the
+    # sequential step-4 replay above only runs for the single-target case.
     if len(targets) > 1:
         import jax
 

@@ -217,12 +217,12 @@ def cmd_edit(args) -> int:
 
     from .utils.progress import trace
 
-    if args.batch_seeds and args.attn_maps:
+    if args.batch_seeds and (args.attn_maps or args.self_attn_maps):
         # Batched groups carry a leading G axis in the store state the viz
         # aggregation doesn't index; honored-flags discipline says reject
         # rather than silently ignore — and before the model load.
-        raise SystemExit("--attn-maps requires the sequential path "
-                         "(drop --batch-seeds)")
+        raise SystemExit("--attn-maps/--self-attn-maps require the "
+                         "sequential path (drop --batch-seeds)")
     pipe = _build_pipeline(args)
     prompts = [args.source, args.target]
     controller = _make_controller(args, prompts, pipe.tokenizer, args.steps)
@@ -248,7 +248,8 @@ def cmd_edit(args) -> int:
                                        scheduler=args.scheduler, latent=x_t,
                                        negative_prompt=args.negative_prompt,
                                        progress=not args.quiet, layout=layout,
-                                       return_store=bool(args.attn_maps))
+                                       return_store=bool(args.attn_maps
+                                                         or args.self_attn_maps))
             # y / y_hat naming per `/root/reference/main.py:375-380,435-444`.
             _save(np.asarray(base[0]),
                   os.path.join(out_dir, f"{seed:05d}_y.jpg"))
@@ -256,6 +257,8 @@ def cmd_edit(args) -> int:
                   os.path.join(out_dir, f"{seed:05d}_y_hat.jpg"))
             if args.attn_maps:
                 _save_attn_maps(args, pipe, layout, store, seed)
+            if args.self_attn_maps:
+                _save_self_attn_maps(args, pipe, layout, store, seed)
     return 0
 
 
@@ -265,22 +268,41 @@ def _save_attn_maps(args, pipe, layout, store, seed) -> None:
     (`/root/reference/main.py:310-327`) as a CLI artifact."""
     from .utils import viz
 
-    # The reference reads the 16×16 level at SD's 64² latent
-    # (`/root/reference/main.py:302,327`): a quarter of the latent side.
-    # Model-derived: largest stored cross resolution ≤ sample_size // 4,
-    # falling back to the largest stored at all (tiny test models).
-    stored = sorted({m.resolution for m in layout.stored_metas()
-                     if m.is_cross and m.place in ("up", "down")})
-    if not stored:
-        raise SystemExit("--attn-maps: no stored up/down cross-attention "
-                         "sites in this model config")
-    want = pipe.config.unet.sample_size // 4
-    res = max((r for r in stored if r <= want), default=stored[-1])
+    res = _stored_res(layout, pipe, cross=True, flag="--attn-maps")
     os.makedirs(args.attn_maps, exist_ok=True)
     viz.show_cross_attention(
         pipe.tokenizer, args.target, layout, store, args.steps, res,
         ("up", "down"), select=1,
         save_path=os.path.join(args.attn_maps, f"{seed:05d}_cross_attn.png"))
+
+
+def _stored_res(layout, pipe, cross: bool, flag: str) -> int:
+    """Model-derived display resolution: the largest stored resolution ≤ a
+    quarter of the latent side (the 16×16 level the reference reads at SD's
+    64² latent, `/root/reference/main.py:302,327`), falling back to the
+    largest stored at all (tiny test models)."""
+    stored = sorted({m.resolution for m in layout.stored_metas()
+                     if m.is_cross == cross and m.place in ("up", "down")})
+    if not stored:
+        kind = "cross" if cross else "self"
+        raise SystemExit(f"{flag}: no stored up/down {kind}-attention "
+                         "sites in this model config")
+    want = pipe.config.unet.sample_size // 4
+    return max((r for r in stored if r <= want), default=stored[-1])
+
+
+def _save_self_attn_maps(args, pipe, layout, store, seed) -> None:
+    """Top-10 SVD components of the self-attention matrix — the reference's
+    `show_self_attention_comp` notebook workflow
+    (`/root/reference/main.py:330-350`) as a CLI artifact."""
+    from .utils import viz
+
+    res = _stored_res(layout, pipe, cross=False, flag="--self-attn-maps")
+    os.makedirs(args.self_attn_maps, exist_ok=True)
+    viz.show_self_attention_comp(
+        layout, store, args.steps, res, ("up", "down"), select=1,
+        save_path=os.path.join(args.self_attn_maps,
+                               f"{seed:05d}_self_attn_svd.png"))
 
 
 def cmd_invert(args) -> int:
@@ -462,6 +484,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also write per-token cross-attention heatmaps of "
                         "the edited prompt (the reference's "
                         "show_cross_attention) into DIR")
+    e.add_argument("--self-attn-maps", default=None, metavar="DIR",
+                   help="also write the top-10 self-attention SVD "
+                        "components of the edited image (the reference's "
+                        "show_self_attention_comp) into DIR")
     e.set_defaults(fn=cmd_edit)
 
     # Inversion is DDIM by construction (`/root/reference/null_text.py:23`);

@@ -292,9 +292,13 @@ TINY_LDM = PipelineConfig("tiny-ldm", TINY_LDM_UNET, TINY_LDM_TEXT,
                               plms_steps_offset=0))
 
 
-# The one preset-name → PipelineConfig map. Every user-facing preset choice
-# (CLI model_opts, `p2p-tpu check`, tools/parity_real_weights.py) derives
-# from this dict so a new preset is added in exactly one place.
+# The one preset-name → PipelineConfig resolution map (CLI commands,
+# `p2p-tpu check`, tools/parity_real_weights.py all resolve through it).
+# The CLI's argparse `choices` tuples are deliberate literal copies — the
+# parser must stay jax-free for instant --help — pinned against this dict
+# by tests/test_cli.py::test_every_cli_preset_resolves_to_a_config; adding
+# a preset means this dict plus those two tuples (the test fails loudly
+# until all agree).
 PRESET_CONFIGS = {
     "tiny": TINY,
     "sd14": SD14,

@@ -92,6 +92,27 @@ def test_edit_attn_maps_writes_heatmaps(tmp_path):
               "--out-dir", out_dir])
 
 
+def test_edit_self_attn_maps_writes_svd_grid(tmp_path):
+    """--self-attn-maps: the reference's show_self_attention_comp
+    (`/root/reference/main.py:330-350`) as a CLI artifact."""
+    out_dir = os.path.join(tmp_path, "run")
+    maps_dir = os.path.join(tmp_path, "selfmaps")
+    assert main(["edit", "--quiet", "--source", "a cat riding a bike",
+                 "--target", "a dog riding a bike", "--mode", "replace",
+                 "--steps", "2", "--seeds", "5", "--out-dir", out_dir,
+                 "--self-attn-maps", maps_dir]) == 0
+    p = os.path.join(maps_dir, "00005_self_attn_svd.png")
+    assert os.path.exists(p)
+    from PIL import Image
+
+    assert np.asarray(Image.open(p)).ndim == 3
+    with pytest.raises(SystemExit):
+        main(["edit", "--quiet", "--source", "a", "--target", "b",
+              "--mode", "replace", "--steps", "2", "--seeds", "1,2",
+              "--batch-seeds", "--self-attn-maps", maps_dir,
+              "--out-dir", out_dir])
+
+
 def test_invert_then_replay(tmp_path):
     from PIL import Image
 
