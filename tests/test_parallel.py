@@ -317,3 +317,37 @@ def test_dp_sweep_uncond_per_step_validation(tiny_pipe):
     with pytest.raises(ValueError, match="G, T, 1, L, D"):
         sweep(tiny_pipe, ctx_g, lats, None, num_steps=2,
               uncond_per_step=ups[0])
+
+
+def test_artifact_replay_inputs_shapes_and_validation(tiny_pipe):
+    from p2p_tpu.parallel import artifact_replay_inputs
+
+    cfg = tiny_pipe.config
+    tok = tiny_pipe.tokenizer
+    steps = 2
+    targets = ["a dog riding a bike", "a fox riding a bike"]
+    ctrls_list = [factory.attention_replace(
+        ["a cat riding a bike", t], steps, cross_replace_steps=0.8,
+        self_replace_steps=0.4, tokenizer=tok, self_max_pixels=64,
+        max_len=cfg.text.max_length) for t in targets]
+    x_t = np.zeros((1,) + tiny_pipe.latent_shape, np.float32)
+    ups = np.zeros((steps, 1, cfg.text.max_length, cfg.text.hidden_dim),
+                   np.float32)
+    ctx_g, lats, ups_g, ctrls = artifact_replay_inputs(
+        tiny_pipe, x_t, ups, "a cat riding a bike", targets, ctrls_list)
+    L, D = ctx_g.shape[-2:]
+    assert ctx_g.shape == (2, 4, L, D)       # (G, 2B) with B=2
+    assert lats.shape == (2, 2) + tiny_pipe.latent_shape
+    assert ups_g.shape == (2,) + ups.shape
+    # The uncond rows are the "" encoding; cond row 0 is the source (helper
+    # encodes all prompts in ONE forward — batch-size reassociation only).
+    enc = encode_prompts(tiny_pipe, ["", "a cat riding a bike"])
+    np.testing.assert_allclose(np.asarray(ctx_g[0][0]), np.asarray(enc[0]),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ctx_g[1][2]), np.asarray(enc[1]),
+                               atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(ctx_g[0][0]),
+                                  np.asarray(ctx_g[1][0]))
+    with pytest.raises(ValueError, match="controllers"):
+        artifact_replay_inputs(tiny_pipe, x_t, ups, "a cat riding a bike",
+                               targets, ctrls_list[:1])
