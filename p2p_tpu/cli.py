@@ -124,7 +124,8 @@ def cmd_generate(args) -> int:
                                            args.negative_prompt)
             imgs, _ = sweep(pipe, ctx, lats, None, num_steps=args.steps,
                             guidance_scale=args.guidance,
-                            scheduler=args.scheduler, mesh=mesh)
+                            scheduler=args.scheduler, mesh=mesh,
+                            progress=not args.quiet)
             for i, seed in enumerate(args.seeds):
                 _save(np.asarray(imgs[i][0]), out_path(seed))
         return 0
@@ -197,7 +198,8 @@ def _edit_batched(args, pipe, prompts, controller, out_dir) -> int:
     ctx, lats, mesh = _group_setup(pipe, prompts, args.seeds,
                                    args.negative_prompt)
     kw = dict(num_steps=args.steps, guidance_scale=args.guidance,
-              scheduler=args.scheduler, mesh=mesh)
+              scheduler=args.scheduler, mesh=mesh,
+              progress=not args.quiet)
     base_imgs, _ = sweep(pipe, ctx, lats, None, **kw)
     ctrls = jax.tree_util.tree_map(
         lambda x: jnp.broadcast_to(x, (g,) + x.shape), controller)
@@ -385,7 +387,7 @@ def _replay_batched(args, pipe, art, targets, out_dir, edited_path) -> int:
         imgs, _ = sweep(pipe, ctx_g, lats, ctrls, num_steps=art.num_steps,
                         guidance_scale=args.guidance,
                         mesh=_dp_mesh(g, f"--batch-targets: {g} targets"),
-                        uncond_per_step=ups)
+                        uncond_per_step=ups, progress=not args.quiet)
         imgs = np.asarray(imgs)
     _save(imgs[0][0], os.path.join(out_dir, "reconstruction.png"))
     for i in range(g):
@@ -465,8 +467,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="output path; seed index suffixed when sweeping")
     g.add_argument("--batch-seeds", action="store_true",
                    help="run the whole seed sweep as one batched program "
-                        "through the dp sweep engine (no per-step progress "
-                        "output in batched mode)")
+                        "through the dp sweep engine")
     g.set_defaults(fn=cmd_generate)
 
     e = sub.add_parser("edit", help="prompt-to-prompt edit with seed sweep")
@@ -478,8 +479,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run the whole seed sweep as batched edit groups "
                         "through the dp sweep engine (two compiled programs "
                         "total instead of two per seed; sharded over the "
-                        "mesh when more than one device is visible; no "
-                        "per-step progress output in batched mode)")
+                        "mesh when more than one device is visible)")
     e.add_argument("--attn-maps", default=None, metavar="DIR",
                    help="also write per-token cross-attention heatmaps of "
                         "the edited prompt (the reference's "
@@ -515,8 +515,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run all --target edits of the artifact as one "
                         "batched program through the dp sweep engine "
                         "(one edit group per target, sharded over the mesh; "
-                        "all targets share --mode/--blend-words/--equalizer; "
-                        "no per-step progress output in batched mode)")
+                        "all targets share --mode/--blend-words/--equalizer)")
     r.set_defaults(fn=cmd_replay)
 
     c = sub.add_parser(

@@ -257,17 +257,15 @@ def invert(
     uncond0 = encode_prompts(pipe, [""], dtype=dtype)
 
     if progress:
-        progress_mod.set_active(
-            progress_mod.StepReporter(num_steps, "ddim-invert"))
+        progress_mod.activate(num_steps, "ddim-invert")
     latent0, x_t, all_latents = _ddim_invert_jit(
         pipe.unet_params, pipe.vae_params, cfg, schedule, image_j, cond,
         progress=progress, sp=sp)
 
     if progress:
-        jax.effects_barrier()  # drain phase-1 callbacks (block_until_ready
-        # only waits on the computation, not on host callback delivery)
-        progress_mod.set_active(
-            progress_mod.StepReporter(num_steps, "null-text opt"))
+        # activate() drains phase-1 callbacks first (block_until_ready only
+        # waits on the computation, not on host callback delivery).
+        progress_mod.activate(num_steps, "null-text opt")
     uncond_list = _null_optimize_jit(
         pipe.unet_params, cfg, schedule, all_latents, uncond0, cond, gs,
         num_inner_steps, jnp.float32(early_stop_epsilon), progress=progress,

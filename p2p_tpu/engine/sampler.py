@@ -256,12 +256,7 @@ def text2image(
 
     x_t, latents = init_latent(latent, pipe.latent_shape, rng, len(prompts), dtype)
     if progress:
-        # Drain any still-in-flight callbacks from a previous progress run
-        # (dispatch is async) so late steps can't poison the new reporter's
-        # monotonic step filter.
-        jax.effects_barrier()
-        total = schedule.timesteps.shape[0]
-        progress_mod.set_active(progress_mod.StepReporter(total))
+        progress_mod.activate(schedule.timesteps.shape[0])
     image, latents_out, state = _text2image_jit(
         pipe.unet_params, pipe.vae_params, cfg, layout, schedule, scheduler,
         context_cond, context_uncond, latents, controller, gs,

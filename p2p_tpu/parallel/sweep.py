@@ -27,7 +27,8 @@ from ..models.config import PipelineConfig
 from ..ops import schedulers as sched_mod
 
 
-@partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind"),
+@partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
+                                   "progress"),
          donate_argnums=())
 def _sweep_jit(
     unet_params: Any,
@@ -41,11 +42,15 @@ def _sweep_jit(
     controllers: Optional[Controller],   # leaves with leading G axis (or None)
     guidance_scale: jax.Array,
     uncond_per_step: Optional[jax.Array],  # (G, T, 1, L, D) or None
+    progress: bool = False,
 ):
     def one_group(ctx, lat, ctrl, ups):
+        # The scanned step index is vmap-invariant (built inside the scan,
+        # independent of the batched inputs), so the progress callback fires
+        # once per step — not once per group.
         lat, state = _denoise_scan(
             unet_params, cfg, layout, schedule, scheduler_kind, ctx, lat, ctrl,
-            guidance_scale, uncond_per_step=ups)
+            guidance_scale, uncond_per_step=ups, progress=progress)
         image = vae_mod.decode(vae_params, cfg.vae, lat.astype(jnp.float32))
         return vae_mod.to_uint8(image), lat
 
@@ -64,6 +69,7 @@ def sweep(
     layout: Optional[AttnLayout] = None,
     mesh: Optional[Mesh] = None,
     uncond_per_step: Optional[jax.Array] = None,
+    progress: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Run G independent edit groups; shard the group axis over ``dp``.
 
@@ -81,7 +87,9 @@ def sweep(
     + SURVEY §3.2, at mesh scale). DDIM-only, like the sequential path.
     Negative-prompt contexts need no parameter here: the uncond rows of
     ``context`` are caller-encoded, so a per-group negative prompt is just
-    a different uncond half. Returns
+    a different uncond half. ``progress=True`` reports per-step progress
+    exactly like ``text2image`` (the scanned step index is group-invariant,
+    so the sweep emits one callback per step). Returns
     ``(images (G,B,H,W,3) uint8, final latents)``.
     """
     cfg = pipe.config
@@ -115,9 +123,15 @@ def sweep(
         if uncond_per_step is not None:
             uncond_per_step = jax.device_put(uncond_per_step, gspec)
 
+    if progress:
+        from ..utils import progress as progress_mod
+
+        progress_mod.activate(schedule.timesteps.shape[0],
+                              f"sweep x{context.shape[0]}")
+
     return _sweep_jit(pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
                       scheduler, context, latents, controllers, gs,
-                      uncond_per_step)
+                      uncond_per_step, progress=progress)
 
 
 def artifact_replay_inputs(pipe, x_t, uncond_embeddings, source: str,

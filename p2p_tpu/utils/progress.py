@@ -69,6 +69,16 @@ def set_active(reporter: Optional[StepReporter]) -> None:
     _active = reporter
 
 
+def activate(total: int, label: str = "sampling") -> None:
+    """Install a fresh reporter for a progress-enabled launch, first
+    draining any still-in-flight callbacks from a previous progress run
+    (dispatch is async) so late steps can't poison the new reporter's
+    monotonic step filter. The one place the drain-then-install discipline
+    lives — used by ``text2image``, ``invert`` phases, and ``sweep``."""
+    jax.effects_barrier()
+    set_active(StepReporter(int(total), label))
+
+
 def _dispatch(step) -> None:
     r = _active
     if r is not None:

@@ -79,3 +79,48 @@ def test_trace_writes_profile(tmp_path):
 def test_trace_none_is_noop():
     with progress.trace(None):
         pass
+
+
+def test_batched_sweep_reports_per_step_progress(tiny_pipe, monkeypatch):
+    """Per-step progress from inside the vmapped dp sweep: the scanned step
+    index is group-invariant, so the sweep emits exactly one callback per
+    step — not one per group."""
+    import io
+
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_tpu.engine.sampler import encode_prompts
+    from p2p_tpu.parallel import seed_latents, sweep
+
+    steps, g = 3, 4
+    prompts = ["a cat riding a bike", "a dog riding a bike"]
+    ctx_c = encode_prompts(tiny_pipe, prompts)
+    ctx_u = encode_prompts(tiny_pipe, [""] * 2)
+    ctx = jnp.broadcast_to(
+        jnp.concatenate([ctx_u, ctx_c], axis=0)[None],
+        (g, 4, ctx_c.shape[1], ctx_c.shape[2]))
+    lats = seed_latents(jax.random.PRNGKey(0), g, 2, tiny_pipe.latent_shape)
+
+    seen = []
+
+    class SpyReporter(progress.StepReporter):
+        def __init__(self, total, label="sampling", stream=None):
+            super().__init__(total, label, stream=io.StringIO())
+
+        def __call__(self, step):
+            seen.append(int(step))
+            super().__call__(step)
+
+    # sweep() installs progress_mod.StepReporter itself; intercept the class
+    # so its reporter records every callback invocation.
+    monkeypatch.setattr(progress, "StepReporter", SpyReporter)
+    try:
+        imgs, _ = sweep(tiny_pipe, ctx, lats, None, num_steps=steps,
+                        progress=True)
+        jax.block_until_ready(imgs)
+        jax.effects_barrier()
+    finally:
+        progress.set_active(None)
+    # Every step exactly once — vmap must not multiply the emissions.
+    assert sorted(seen) == list(range(steps))
