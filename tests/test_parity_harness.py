@@ -60,7 +60,7 @@ def test_harness_end_to_end_on_random_hf_checkpoint(tmp_path):
     out = tmp_path / "out"
     proc = subprocess.run(
         [sys.executable, HARNESS, str(ckpt), "--preset", "tiny",
-         "--steps", "2", "--out-dir", str(out)],
+         "--steps", "2", "--dpm-operating-point", "--out-dir", str(out)],
         env=_cpu_env(), timeout=900, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True)
     assert proc.returncode == 0, f"harness failed:\n{proc.stdout[-4000:]}"
@@ -79,6 +79,10 @@ def test_harness_end_to_end_on_random_hf_checkpoint(tmp_path):
     assert (out / "ours_0.png").exists()
     assert (out / "torch_ref_0.png").exists()
     assert report["edit_precompute"]  # which precompute path was used
+    # --dpm-operating-point: both solver renders + a PSNR in the report.
+    assert (out / "quality_ddim4.png").exists()
+    assert (out / "quality_dpm2.png").exists()
+    assert report["dpm_operating_point"]["psnr_db"] > 0
 
 
 @pytest.mark.slow
