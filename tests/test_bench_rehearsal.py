@@ -166,6 +166,103 @@ def test_measure_child_refuses_cpu_for_sd14():
     assert not [l for l in proc.stdout.splitlines() if l.startswith("{")]
 
 
+def test_secondaries_filter_semantics():
+    # The chip-window narrowing env: honored only for the real sd14 run,
+    # never for rehearsal (its CI must keep covering every block) or tiny.
+    bench = _import_bench()
+    assert bench._secondaries_filter("sd14", None) is None
+    assert bench._secondaries_filter("sd14", "") is None
+    assert bench._secondaries_filter("rehearse", "ldm256") is None
+    assert bench._secondaries_filter("tiny", "ldm256") is None
+    got = bench._secondaries_filter("sd14", "ldm256, nullinv")
+    assert got == frozenset({"ldm256", "nullinv"})
+    with pytest.raises(SystemExit):
+        bench._secondaries_filter("sd14", "ldm256,typo")
+    # A comma/whitespace-only value is an error, not a skip-everything.
+    with pytest.raises(SystemExit):
+        bench._secondaries_filter("sd14", " , ")
+    # dpm_batched depends on the controller dpm builds: auto-included.
+    assert bench._secondaries_filter("sd14", "dpm_batched") == frozenset(
+        {"dpm", "dpm_batched"})
+
+
+def test_archive_narrowed_merge_semantics(tmp_path, monkeypatch):
+    # A narrowed run (P2P_BENCH_SECONDARIES) reports a value-0 headline with
+    # a "narrowed" marker. Merging into a same-day full sweep must absorb
+    # its keys and DROP the marker (the surviving headline is real); on a
+    # fresh day the marker must survive into the artifact and its
+    # provenance summary, and best_onchip must still point at the earlier
+    # full sweep.
+    bench = _import_bench()
+    monkeypatch.setattr(bench, "_BENCH_RUNS", str(tmp_path))
+    full = {"metric": "sd14_512_replace_edit_50step_imgs_per_s",
+            "value": 0.94, "variant": "batched_4groups", "vs_baseline": 0.235,
+            "platform": "tpu", "dpm20_imgs_per_s": 1.58}
+    monkeypatch.setattr(bench.time, "gmtime",
+                        lambda: (2026, 8, 1, 0, 0, 0, 0, 213, 0))
+    bench._archive_onchip(full)
+    narrowed = {"metric": "sd14_512_replace_edit_50step_imgs_per_s",
+                "value": 0.0, "variant": "narrowed", "vs_baseline": 0.0,
+                "platform": "tpu", "narrowed": "nullinv",
+                "nullinv_s_per_image": 210.0}
+    bench._archive_onchip(narrowed)
+    with open(tmp_path / "2026-08-01_sd14_onchip.json") as f:
+        doc = json.load(f)
+    assert doc["value"] == 0.94 and doc["nullinv_s_per_image"] == 210.0
+    assert "narrowed" not in doc  # full headline survived: not partial
+
+    # Fresh day, no full sweep to merge with: marker survives and is
+    # surfaced; best_onchip still reports the older full measurement.
+    monkeypatch.setattr(bench.time, "gmtime",
+                        lambda: (2026, 8, 2, 0, 0, 0, 0, 214, 0))
+    bench._archive_onchip(dict(narrowed, nullinv_s_per_image=205.0))
+    newest, best = bench._load_onchip_provenance()
+    assert newest["date"] == "2026-08-02" and newest["narrowed"] == "nullinv"
+    assert newest["value"] == 0.0
+    assert best["date"] == "2026-08-01" and best["value"] == 0.94
+    # Two narrowed runs on one day union their block lists.
+    ldm_run = {k: v for k, v in narrowed.items() if k != "nullinv_s_per_image"}
+    bench._archive_onchip(dict(ldm_run, narrowed="ldm256",
+                               ldm256_8prompt_imgs_per_s=0.5))
+    with open(tmp_path / "2026-08-02_sd14_onchip.json") as f:
+        doc = json.load(f)
+    assert doc["narrowed"] == "ldm256,nullinv"
+    assert doc["nullinv_s_per_image"] == 205.0
+    assert doc["ldm256_8prompt_imgs_per_s"] == 0.5
+    # An existing narrowed doc that wins the headline still unions the
+    # incoming run's blocks into the marker (not just its own).
+    gsweep_run = {"metric": full["metric"], "value": 0.93,
+                  "variant": "batched_8groups", "vs_baseline": 0.2325,
+                  "platform": "tpu", "narrowed": "gsweep"}
+    monkeypatch.setattr(bench.time, "gmtime",
+                        lambda: (2026, 8, 3, 0, 0, 0, 0, 215, 0))
+    bench._archive_onchip(gsweep_run)
+    bench._archive_onchip(narrowed)  # value 0 loses to 0.93
+    with open(tmp_path / "2026-08-03_sd14_onchip.json") as f:
+        doc = json.load(f)
+    assert doc["value"] == 0.93
+    assert doc["narrowed"] == "gsweep,nullinv"
+    assert doc["nullinv_s_per_image"] == 210.0
+    # A gsweep-narrowed run whose real batched headline beats the day's
+    # full sweep must not mark the merged (fully-covered) doc partial.
+    monkeypatch.setattr(bench.time, "gmtime",
+                        lambda: (2026, 8, 4, 0, 0, 0, 0, 216, 0))
+    bench._archive_onchip(full)
+    bench._archive_onchip(dict(gsweep_run, value=0.95))
+    with open(tmp_path / "2026-08-04_sd14_onchip.json") as f:
+        doc = json.load(f)
+    assert doc["value"] == 0.95 and "narrowed" not in doc
+    assert doc["dpm20_imgs_per_s"] == 1.58
+    # A later full sweep upgrades a narrowed fresh-day artifact to unmarked.
+    monkeypatch.setattr(bench.time, "gmtime",
+                        lambda: (2026, 8, 2, 0, 0, 0, 0, 214, 0))
+    bench._archive_onchip(full)
+    with open(tmp_path / "2026-08-02_sd14_onchip.json") as f:
+        doc = json.load(f)
+    assert doc["value"] == 0.94 and "narrowed" not in doc
+    assert doc["nullinv_s_per_image"] == 205.0
+
+
 def test_load_last_onchip_absent_dir_is_none(tmp_path, monkeypatch):
     bench = _import_bench()
     monkeypatch.setattr(bench, "_BENCH_RUNS", str(tmp_path / "nope"))

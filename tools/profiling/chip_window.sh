@@ -5,6 +5,11 @@
 # deliberately NOT here — stopping a trace can wedge the lease; run
 # prof_trace.py manually, last, when nothing else is pending.
 #
+# Every gap-filling step gates on the committed archive actually missing
+# its artifact, so the script stays correct across days: once the A/Bs and
+# the two narrowed secondaries have landed, a future window goes straight
+# to the full headline bench.
+#
 #   tools/profiling/chip_window.sh [logdir]      # run now
 #
 set -u
@@ -20,11 +25,41 @@ run() { # name timeout cmd...
   echo "=== $name rc=$rc $(date -u +%H:%M:%S)" | tee -a "$L/runner.log"
 }
 
-# 1. The driver metric + cache priming for every program bench now times
-#    (incl. the dpm-batched and null-inversion secondaries).
-run bench 1800 python bench.py
-# 2. A/B experiments: upsample, head-dim pad, batch scaling, VAE dtype.
-run experiments 1500 python tools/profiling/prof_experiments.py
-# 3. Scan unroll probe.
+# True iff any committed on-chip artifact already carries the metric key.
+have_metric() {
+  python - "$1" <<'PY'
+import glob, json, sys
+for p in glob.glob("bench_runs/*_onchip.json"):
+    try:
+        if sys.argv[1] in json.load(open(p)):
+            sys.exit(0)
+    except Exception:
+        pass
+sys.exit(1)
+PY
+}
+
+# 1. A/B experiments (upsample, head-dim pad64/pad128, qkv-fuse, batch
+#    scaling, VAE dtype) — once per repo state; the log is preserved as a
+#    committed artifact, which is also the re-run gate.
+if ! ls bench_runs/*_experiments.log >/dev/null 2>&1; then
+  run experiments 1500 python tools/profiling/prof_experiments.py
+  grep -q "ms/step" "$L/experiments.log" && \
+    cp "$L/experiments.log" "bench_runs/$(date -u +%F)_experiments.log"
+fi
+# 2+3. Narrowed runs for any secondary the archive has never measured, one
+#    invocation each so each gets the full child budget even cold-cache
+#    (nullinv's two programs are the most expensive compile in the bench).
+#    Narrowed runs skip the headline (value-0 line + "narrowed" marker);
+#    the same-day merge absorbs the new keys into a full artifact.
+have_metric nullinv_s_per_image || \
+  run bench_nullinv 1800 env P2P_BENCH_SECONDARIES=nullinv python bench.py
+have_metric ldm256_8prompt_imgs_per_s || \
+  run bench_ldm256 1800 env P2P_BENCH_SECONDARIES=ldm256 python bench.py
+# 4. Full driver-metric refresh (also re-primes every program's cache for
+#    the driver's round-end run). -u: an operator-exported narrowing from a
+#    manual recovery run must not silently narrow the refresh.
+run bench 1800 env -u P2P_BENCH_SECONDARIES python bench.py
+# 5. Scan unroll probe.
 run unroll 1200 python tools/profiling/prof_unroll.py
 echo "window done; logs in $L" | tee -a "$L/runner.log"
