@@ -314,6 +314,9 @@ def test_bench_rehearsal_green_and_complete():
     missing = EXPECTED_KEYS - set(doc)
     assert not missing, f"rehearsal line missing keys: {sorted(missing)}"
     assert doc["value"] > 0
+    # Rehearsal must never narrow (a stray P2P_BENCH_SECONDARIES is
+    # ignored off-sd14): every block above actually ran.
+    assert "narrowed" not in doc
 
 def test_onchip_provenance_survives_binary_corrupt_artifact(
         tmp_path, monkeypatch):
