@@ -79,15 +79,34 @@ def _relay_port_accepts(port=8083, timeout=5):
         return False
 
 
-def _probe_accelerator(timeout=180, attempts=3, backoffs=(15, 45)):
-    """True iff a non-CPU jax backend initializes within `timeout` seconds."""
+def _probe_accelerator(timeout=180, attempts=5, backoffs=(15, 45, 90, 180),
+                       budget=720):
+    """True iff a non-CPU jax backend initializes within `timeout` seconds.
+
+    The attempt schedule spans >5 minutes of fast-failing probes because of
+    a measured relay mode (2026-08-01): after a chip client exits, the axon
+    lease stays held for ~4.5 minutes, during which the port accepts but
+    plugin init fails (jax falls back to CPU). Back-to-back bench runs — the
+    chip_window.sh step pattern — land exactly in that hole; riding it out
+    costs nothing when the relay is truly dead (the port gate keeps the
+    dead-relay path to backoff sleeps plus one full probe).
+
+    `budget` bounds the whole schedule for the OTHER failure mode, a wedged
+    lease where every probe subprocess hangs to `timeout`: no new attempt
+    starts past it, capping the worst case at budget+timeout ≈ 15 min of
+    the 26-min _DEADLINE_S so the CPU fallback always keeps more than its
+    _FALLBACK_RESERVE_S."""
     code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
     # The port gate only applies when the accelerator IS the loopback axon
     # relay (any other attachment must always get the real python probe),
     # and never on the final attempt — it is a fast path for the known
     # relay-death mode, not a substitute for the probe.
     gated = os.environ.get("PALLAS_AXON_POOL_IPS") == "127.0.0.1"
+    expects_accel = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+    start = time.monotonic()
     for i in range(attempts):
+        if i and time.monotonic() - start > budget:
+            break
         if gated and i < attempts - 1 and not _relay_port_accepts():
             time.sleep(backoffs[min(i, len(backoffs) - 1)])
             continue
@@ -98,10 +117,20 @@ def _probe_accelerator(timeout=180, attempts=3, backoffs=(15, 45)):
                 stderr=subprocess.STDOUT, text=True)
             for line in proc.stdout.splitlines():
                 if line.startswith("PLATFORM="):
-                    return line.split("=", 1)[1] != "cpu"
+                    if line.split("=", 1)[1] != "cpu":
+                        return True
+                    if not expects_accel:
+                        # No accelerator plugin configured: cpu is the
+                        # machine's real answer, not a failed init.
+                        return False
+                    # A plugin IS configured, so PLATFORM=cpu means its init
+                    # failed (jax demotes with only a warning) — in the
+                    # lease-release hole this resolves in the NEXT window,
+                    # so it must burn an attempt, not end the probe.
+                    break
         except subprocess.TimeoutExpired:
             pass
-        if i < attempts - 1:
+        if i < attempts - 1 and time.monotonic() - start <= budget:
             time.sleep(backoffs[min(i, len(backoffs) - 1)])
     return False
 

@@ -294,6 +294,102 @@ def test_probe_port_gate_only_skips_nonfinal_loopback_attempts(monkeypatch):
     assert len(calls) == 3  # non-loopback attachment: no port gating at all
 
 
+def test_probe_cpu_demotion_retries_when_plugin_configured(monkeypatch):
+    """PLATFORM=cpu with an accelerator plugin configured means the plugin
+    failed init (the ~4.5-min axon lease-release hole, measured 2026-08-01),
+    NOT that the machine is CPU-only — the probe must burn an attempt and
+    retry, and succeed when a later attempt sees the real platform."""
+    bench = _import_bench()
+    answers = iter(["cpu", "cpu", "tpu"])
+    calls = []
+
+    def fake_run(*a, **k):
+        calls.append(1)
+
+        class _Proc:
+            stdout = f"PLATFORM={next(answers)}"
+        return _Proc()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+    monkeypatch.setattr(bench, "_relay_port_accepts", lambda **k: True)
+
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    assert bench._probe_accelerator() is True
+    assert len(calls) == 3          # two cpu demotions retried, then tpu
+    assert sum(sleeps) >= 60        # backoffs actually separate the attempts
+
+    # Without a configured plugin, cpu is the machine's real answer: no retry.
+    calls.clear()
+    answers = iter(["cpu", "cpu", "tpu"])
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS")
+    assert bench._probe_accelerator() is False
+    assert len(calls) == 1
+
+
+def test_probe_backoff_schedule_spans_lease_release(monkeypatch):
+    """The full fast-fail schedule must keep probing past the measured
+    ~4.5-minute lease-release latency."""
+    bench = _import_bench()
+
+    class _Proc:
+        stdout = "PLATFORM=cpu"
+
+    monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: _Proc())
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+    monkeypatch.setattr(bench, "_relay_port_accepts", lambda **k: True)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    assert bench._probe_accelerator() is False
+    assert sum(sleeps) >= 300       # sleeps alone clear the ~4.5-min hole
+
+
+def test_probe_budget_caps_wedged_lease_hangs(monkeypatch):
+    """Wedged-lease mode (every probe subprocess hangs to its timeout) must
+    not let the widened attempt schedule starve the CPU fallback: no attempt
+    starts past the budget, bounding the probe at budget+timeout."""
+    bench = _import_bench()
+    clock = [0.0]
+    monkeypatch.setattr(bench.time, "monotonic", lambda: clock[0])
+    monkeypatch.setattr(bench.time, "sleep",
+                        lambda s: clock.__setitem__(0, clock[0] + s))
+    calls = []
+
+    def hang(*a, **k):
+        calls.append(1)
+        clock[0] += 180
+        raise bench.subprocess.TimeoutExpired(cmd="probe", timeout=180)
+
+    monkeypatch.setattr(bench.subprocess, "run", hang)
+    monkeypatch.setattr(bench, "_relay_port_accepts", lambda **k: True)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    assert bench._probe_accelerator() is False
+    assert len(calls) == 4            # attempt 5 would start past the budget
+    assert clock[0] <= 720 + 180      # fallback keeps > _FALLBACK_RESERVE_S
+
+
+def test_prof_experiments_tiny_smoke_lane_validates_qkv():
+    """The experiments harness's CPU smoke lane must actually gate the qkv
+    A/B: it runs the monkeypatched variant end-to-end at TINY scale and
+    hard-asserts bit-exact parity (a dtype regression like the one that
+    crashed the 2026-08-01 chip run dies here, not on a scarce window)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["P2P_EXP_PRESET"] = "tiny"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, ".jax_cache"))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "profiling",
+                                      "prof_experiments.py"), "--qkv"],
+        env=env, cwd=REPO, timeout=600, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    assert "qkv-fused parity max|Δeps| = 0.000e+00" in proc.stdout
+    assert "qkv-fused projections" in proc.stdout
+
+
 @pytest.mark.slow
 def test_bench_rehearsal_green_and_complete():
     env = dict(os.environ)

@@ -12,6 +12,24 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
+def require_accelerator():
+    """Exit rather than time SD14 programs on a silently-demoted CPU backend.
+
+    When the axon plugin fails init (relay death, or the ~4.5-min lease
+    -release hole after another chip client exits — measured 2026-08-01),
+    jax falls back to CPU with only a warning, and a profiling tool would
+    print plausible-looking but meaningless numbers into a log that
+    chip_window.sh may archive. P2P_PROF_ALLOW_CPU=1 overrides for anyone
+    who really wants host timings."""
+    import jax
+
+    if (jax.devices()[0].platform == "cpu"
+            and os.environ.get("P2P_PROF_ALLOW_CPU") != "1"):
+        sys.exit("profiling refused: jax backend is cpu (accelerator plugin "
+                 "failed init or none configured); set P2P_PROF_ALLOW_CPU=1 "
+                 "to time the host")
+
+
 def sd14_scan_ms_per_step(batch: int = 4, steps: int = 50, repeats: int = 2,
                           compiler_options=None, unroll: int = 1) -> float:
     """Best-of-N ms/step for the jitted SD14 U-Net scan (identity controller).
@@ -28,6 +46,7 @@ def sd14_scan_ms_per_step(batch: int = 4, steps: int = 50, repeats: int = 2,
     from p2p_tpu.models.unet import apply_unet
     from p2p_tpu.utils.cache import enable_persistent_cache
 
+    require_accelerator()
     enable_persistent_cache()
 
     cfg = SD14

@@ -7,6 +7,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bench_common import require_accelerator
+
+require_accelerator()
 d = jax.devices()[0]
 print(f"device: {d.device_kind} platform={d.platform}", flush=True)
 

@@ -9,6 +9,11 @@ from p2p_tpu.models.unet import apply_unet
 import p2p_tpu.models.unet as unet_mod
 from jax.experimental.pallas.ops.tpu import flash_attention as _fa
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bench_common import require_accelerator
+
+require_accelerator()
+
 cfg = SD14
 layout = unet_layout(cfg.unet)
 params = init_unet(jax.random.PRNGKey(0), cfg.unet)

@@ -103,6 +103,9 @@ def capture(logdir: str):
     from p2p_tpu.models.unet import apply_unet
     from p2p_tpu.utils.cache import enable_persistent_cache
 
+    from _bench_common import require_accelerator
+
+    require_accelerator()
     enable_persistent_cache()
     cfg = SD14
     layout = unet_layout(cfg.unet)

@@ -19,6 +19,10 @@ from p2p_tpu.models import SD14, init_text_encoder, init_unet
 from p2p_tpu.models import vae as vae_mod
 from p2p_tpu.utils.tokenizer import HashWordTokenizer
 
+from _bench_common import require_accelerator
+
+require_accelerator()
+
 NUM_STEPS = 50
 cfg = SD14
 tok = HashWordTokenizer(model_max_length=cfg.text.max_length)
