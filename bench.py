@@ -24,7 +24,7 @@ DDIM Replace workload. Budget-gated secondaries then cover every other
 BASELINE.json config and the quality-matched operating point, as extras in
 the same JSON line:
 
-  dpm20_imgs_per_s / dpm20_batched_8groups_imgs_per_s  (DPM-Solver++(2M)
+  dpm20_imgs_per_s / dpm20_batched_{8,4}groups_imgs_per_s  (DPM-Solver++(2M)
       20 steps ≈ 50-step-DDIM quality, PERF.md)
   reweight_eqsweep_4groups_imgs_per_s    (config 3: equalizer sweep)
   refine_localblend_imgs_per_s           (config 2: Refine + LocalBlend)
@@ -628,15 +628,27 @@ def _measure(preset):
             extras["dpm20_imgs_per_s"] = round(timed(run_dpm) * len(prompts), 4)
             dpm_ctrl["ctrl"] = ctrl
 
-        # DPM at the best batched operating point (g=8): the highest
-        # practical quality-matched rate the chip reaches. Secondary extras
-        # only — the headline metric stays the spec'd 50-step DDIM workload.
+        # DPM at batched operating points: the highest practical
+        # quality-matched rate the chip reaches. g=8 first (the key every
+        # archived artifact since r3 carries), then g=4 — the 2026-08-01
+        # DDIM g-sweep peaked at g=2/g=4, so the DPM optimum is plausibly
+        # below 8 too; measure rather than assume. Secondary extras only —
+        # the headline metric stays the spec'd 50-step DDIM workload.
         def dpm_batched():
-            g = 8
-            ctrls8 = broadcast_groups(g, dpm_ctrl["ctrl"])
-            rate = timed(lambda s: run_batched(
-                g, ctrls8, s, steps=20, scheduler="dpm")) * g * len(prompts)
-            extras["dpm20_batched_8groups_imgs_per_s"] = round(rate, 4)
+            for g in (8, 4):
+                ctrls_g = broadcast_groups(g, dpm_ctrl["ctrl"])
+                rate = timed(lambda s, g=g, c=ctrls_g: run_batched(
+                    g, c, s, steps=20, scheduler="dpm")) * g * len(prompts)
+                extras[f"dpm20_batched_{g}groups_imgs_per_s"] = round(rate, 4)
+                # Best-so-far after every variant: a timeout kill during the
+                # next g must not lose this one (same contract as the DDIM
+                # g-sweep).
+                report()
+                if g == 8 and time_left() <= 300:
+                    # Each g is a fresh XLA program; don't start a compile
+                    # that can't finish (mirrors the DDIM sweep's threshold).
+                    note(f"dpm batched g=4 skipped: {time_left():.0f}s left")
+                    break
 
         # BASELINE config 3: AttentionReweight equalizer sweep — 4 groups
         # with per-group equalizer scales riding ONE compiled program (the

@@ -20,6 +20,7 @@ EXPECTED_KEYS = {
     "batched_2groups_imgs_per_s", "batched_4groups_imgs_per_s",
     "batched_8groups_imgs_per_s",
     "dpm20_imgs_per_s", "dpm20_batched_8groups_imgs_per_s",
+    "dpm20_batched_4groups_imgs_per_s",
     "reweight_eqsweep_4groups_imgs_per_s",
     "refine_localblend_imgs_per_s",
     "ldm256_8prompt_imgs_per_s",
