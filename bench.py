@@ -293,14 +293,18 @@ def _parse_last_json(text):
     return None
 
 
-def _run_inner(preset, env, timeout):
+def _run_inner(preset, env, timeout, budget=None):
     """Run the measurement subprocess; return the parsed JSON line, None on
     a non-timeout failure, or the _TIMEOUT sentinel.
 
     The child prints its current-best line after every completed variant, so
-    even a timeout kill mid-sweep yields the best measurement so far."""
+    even a timeout kill mid-sweep yields the best measurement so far.
+    ``budget`` overrides the child's measurement budget when it should not
+    equal the subprocess leash — patient mode's leash includes an unbounded
+    lease wait, and a child pacing its secondaries against that number
+    would think it has hours after a delayed attach."""
     env = dict(env)
-    env["P2P_BENCH_BUDGET_S"] = str(int(timeout))
+    env["P2P_BENCH_BUDGET_S"] = str(int(budget or timeout))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--inner", preset],
@@ -324,7 +328,33 @@ def main():
                          "itself — run with JAX_PLATFORMS=cpu)")
     ap.add_argument("--inner", metavar="PRESET",
                     help=argparse.SUPPRESS)  # measurement child process
+    ap.add_argument("--patient", nargs="?", type=int, const=10800,
+                    metavar="SECONDS",
+                    help="wedge-mode operator capture: skip the probe and "
+                         "launch the sd14 measurement child directly with "
+                         "this leash (default 10800s); the leash expiring "
+                         "is the ONLY kill, so make it generous — killed "
+                         "mid-flight TPU jobs (like timeout-killed probe "
+                         "subprocesses) are what sustains a wedge "
+                         "(measured 2026-08-01). The child's backend init "
+                         "waits inside jax's own retry loop until the "
+                         "wedged lease frees. The child's "
+                         "measurement budget starts only after attach and "
+                         "is capped at the standard 1800s (not the leash — "
+                         "which mostly buys waiting time); a capture can "
+                         "still be cut short if the wait consumed nearly "
+                         "the whole leash. Combines with "
+                         "P2P_BENCH_SECONDARIES narrowing.")
     args = ap.parse_args()
+
+    if args.patient is not None:
+        # Reject combinations that would silently fall through to the probe
+        # path — the exact probe-kill cycle the flag exists to avoid.
+        if args.patient <= 0:
+            ap.error("--patient needs a positive leash in seconds")
+        if args.preset not in ("auto", "sd14"):
+            ap.error("--patient only applies to the sd14 measurement "
+                     f"(--preset {args.preset} given)")
 
     if args.inner:
         return _measure(args.inner)
@@ -354,7 +384,27 @@ def main():
 
     preset = args.preset
     result = None
-    if preset != "tiny" and _probe_accelerator():
+    if args.patient and preset in ("auto", "sd14"):
+        # Operator tool, not a driver path: no probe (whose timeout-kills
+        # can sustain the wedge it is probing), no deadline carving. In
+        # wedge mode the child hangs politely in backend init; in
+        # lease-HOLE mode it instead fails fast (jax demotes to CPU, the
+        # child's platform gate refuses) — relaunch until the leash runs
+        # out. A failed capture still falls through to the fallback ladder
+        # so the one-JSON-line contract holds.
+        patient_end = t0 + args.patient
+        while True:
+            leash = patient_end - time.monotonic()
+            if leash < 60:
+                break
+            result = _run_inner("sd14", dict(os.environ), timeout=leash,
+                                budget=min(1800, int(leash)))
+            if result is not None and result is not _TIMEOUT:
+                break
+            print(f"patient: child exited without a result; relaunching "
+                  f"({leash:.0f}s had remained)", file=sys.stderr)
+            time.sleep(min(240, max(0, patient_end - time.monotonic())))
+    elif preset != "tiny" and _probe_accelerator():
         # First attempt gets the longest leash the deadline allows: a cold
         # compile of the SD-1.4 program is minutes of single-core XLA work
         # before any step runs. (The child reports its best-so-far after each

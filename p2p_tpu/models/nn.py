@@ -276,13 +276,24 @@ def flash_block(seq_len: int, head_dim: int, itemsize: int) -> int:
 
 def _flash_block_sizes(blk: int):
     """The one BlockSizes geometry every flash call site uses — forward and
-    residuals variants must stay on the same tiling."""
+    residuals variants must stay on the same tiling.
+
+    ALL backward blocks (dkv AND dq passes) must be specified or
+    differentiating any program containing the kernel raises at trace time
+    ("not all backward blocks are specified") — null-text inversion
+    backprops through the U-Net's S=4096 flash sites, which is exactly how
+    this surfaced on chip (2026-08-01). The backward passes hold more live
+    tiles than the forward, so they get a capped block; correctness of the
+    spec is pinned by an interpret-mode grad test
+    (tests/test_flash_pallas.py)."""
     from jax.experimental.pallas.ops.tpu import flash_attention as _fa
 
+    bwd = min(blk, 512)
     return _fa.BlockSizes(
         block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
-        block_q_major_dkv=blk, block_k_major_dkv=blk,
-        block_q_dkv=blk, block_k_dkv=blk)
+        block_q_major_dkv=bwd, block_k_major_dkv=bwd,
+        block_q_dkv=bwd, block_k_dkv=bwd,
+        block_k_major_dq=bwd, block_k_dq=bwd, block_q_dq=bwd)
 
 
 def flash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -391,6 +391,54 @@ def test_prof_experiments_tiny_smoke_lane_validates_qkv():
     assert "qkv-fused projections" in proc.stdout
 
 
+def test_patient_mode_skips_probe_and_relaunches(monkeypatch, capsys):
+    """--patient must never run the probe (its timeout-kills can sustain
+    the wedge it is probing) and must relaunch a child that fails fast in
+    a lease hole, until the leash runs out or a result lands."""
+    bench = _import_bench()
+    clock = [0.0]
+    monkeypatch.setattr(bench.time, "monotonic", lambda: clock[0])
+    monkeypatch.setattr(bench.time, "sleep",
+                        lambda s: clock.__setitem__(0, clock[0] + s))
+    monkeypatch.setattr(
+        bench, "_probe_accelerator",
+        lambda *a, **k: pytest.fail("probe must not run in patient mode"))
+    archived = []
+    monkeypatch.setattr(bench, "_archive_onchip", archived.append)
+    calls = []
+    results = iter([None,
+                    {"metric": "sd14_patient_test", "value": 1.0,
+                     "unit": "img/s/chip", "vs_baseline": 0.25,
+                     "platform": "tpu"}])
+
+    def fake_inner(preset, env, timeout, budget=None):
+        calls.append((preset, timeout, budget))
+        clock[0] += 10
+        return next(results)
+
+    monkeypatch.setattr(bench, "_run_inner", fake_inner)
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--patient", "600"])
+    assert bench.main() == 0
+    assert [c[0] for c in calls] == ["sd14", "sd14"]  # fast-fail relaunched
+    # The child's budget is the post-attach measurement window, never the
+    # leash (which mostly buys lease-wait time).
+    assert all(c[2] == min(1800, int(c[1])) for c in calls)
+    assert archived and archived[0]["metric"] == "sd14_patient_test"
+    assert '"sd14_patient_test"' in capsys.readouterr().out
+
+
+def test_patient_mode_rejects_probe_fallthrough_combos(monkeypatch):
+    """--patient 0 and --patient with --preset tiny must be argparse errors,
+    not a silent fall-through to the probe path the flag exists to avoid."""
+    bench = _import_bench()
+    for argv in (["bench.py", "--patient", "0"],
+                 ["bench.py", "--patient", "--preset", "tiny"]):
+        monkeypatch.setattr(sys, "argv", argv)
+        with pytest.raises(SystemExit) as exc:
+            bench.main()
+        assert exc.value.code == 2  # argparse error exit
+
+
 @pytest.mark.slow
 def test_bench_rehearsal_green_and_complete():
     env = dict(os.environ)

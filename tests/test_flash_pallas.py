@@ -99,6 +99,44 @@ def test_flash_interpret_parity_vae_head_geometry():
                                atol=1e-4, rtol=1e-5)
 
 
+def test_flash_interpret_grad_matches_einsum():
+    """Differentiating THROUGH the flash kernel must work and match the
+    materialized-attention gradient: null-text inversion backprops through
+    the U-Net's S=4096 flash sites, and an under-specified BlockSizes (the
+    dq backward blocks missing) raises "not all backward blocks are
+    specified" at trace time — exactly how this surfaced on chip
+    (2026-08-01). blk=1024 at S=1024 exercises the MIXED tiling the fix
+    actually ships at the S=4096 production sites: forward blocks 1024,
+    backward blocks capped at 512 — so a numeric bug specific to unequal
+    forward/backward tiling (e.g. dq accumulation across the two backward
+    k-blocks per forward block) dies here, not in a scarce chip window."""
+    s, d = 1024, 40
+    blk = 1024
+    assert nn.flash_block(s, d, 4) == blk  # the production selection
+    q, k, v = _rand_qkv(5, 1, 2, s, d, jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+
+    def loss_flash(q):
+        return jnp.sum(nn.flash_attention_tpu(q, k, v, scale, blk) ** 2)
+
+    def loss_ref(q):
+        return jnp.sum(_ref(q, k, v, scale) ** 2)
+
+    with pltpu.force_tpu_interpret_mode():
+        g_flash = jax.grad(loss_flash)(q)
+    g_ref = jax.grad(loss_ref)(q)
+    np.testing.assert_allclose(np.asarray(g_flash), np.asarray(g_ref),
+                               atol=1e-3, rtol=1e-3)
+
+
+def test_flash_block_sizes_specify_all_backward_blocks():
+    """The shared BlockSizes geometry must stay fully backward-specified —
+    any future pallas field addition that reopens the trace-time error
+    shows up here, not in a scarce chip window."""
+    assert nn._flash_block_sizes(1024).has_backward_blocks
+    assert nn._flash_block_sizes(256).has_backward_blocks
+
+
 def test_flash_block_selection():
     # Tiling-only selection at the narrow SD head geometry (VMEM not binding).
     assert nn.flash_block(4096, 40, 2) == 1024
