@@ -125,7 +125,7 @@ def cmd_generate(args) -> int:
             imgs, _ = sweep(pipe, ctx, lats, None, num_steps=args.steps,
                             guidance_scale=args.guidance,
                             scheduler=args.scheduler, mesh=mesh,
-                            progress=not args.quiet)
+                            gate=args.gate, progress=not args.quiet)
             for i, seed in enumerate(args.seeds):
                 _save(np.asarray(imgs[i][0]), out_path(seed))
         return 0
@@ -138,6 +138,7 @@ def cmd_generate(args) -> int:
                                    scheduler=args.scheduler,
                                    rng=jax.random.PRNGKey(seed),
                                    negative_prompt=args.negative_prompt,
+                                   gate=args.gate,
                                    progress=not args.quiet)
             _save(np.asarray(img[0]), out_path(seed))
     return 0
@@ -198,7 +199,7 @@ def _edit_batched(args, pipe, prompts, controller, out_dir) -> int:
     ctx, lats, mesh = _group_setup(pipe, prompts, args.seeds,
                                    args.negative_prompt)
     kw = dict(num_steps=args.steps, guidance_scale=args.guidance,
-              scheduler=args.scheduler, mesh=mesh,
+              scheduler=args.scheduler, mesh=mesh, gate=args.gate,
               progress=not args.quiet)
     base_imgs, _ = sweep(pipe, ctx, lats, None, **kw)
     ctrls = jax.tree_util.tree_map(
@@ -243,12 +244,14 @@ def cmd_edit(args) -> int:
                                       guidance_scale=args.guidance,
                                       scheduler=args.scheduler, rng=rng,
                                       negative_prompt=args.negative_prompt,
+                                      gate=args.gate,
                                       progress=not args.quiet, layout=layout)
             img, _, store = text2image(pipe, prompts, controller,
                                        num_steps=args.steps,
                                        guidance_scale=args.guidance,
                                        scheduler=args.scheduler, latent=x_t,
                                        negative_prompt=args.negative_prompt,
+                                       gate=args.gate,
                                        progress=not args.quiet, layout=layout,
                                        return_store=bool(args.attn_maps
                                                          or args.self_attn_maps))
@@ -407,6 +410,20 @@ def _int_list(s: str) -> List[int]:
     return [int(x) for x in s.split(",") if x]
 
 
+def _gate_spec(s: str):
+    """Parse ``--gate``: 'auto' | a fraction with a dot ('0.5') | an absolute
+    step index ('25'). Kept jax-free; full validation (range, controller
+    window, null-text conflicts) happens in ``engine.sampler.resolve_gate``."""
+    if s == "auto":
+        return "auto"
+    try:
+        return float(s) if "." in s else int(s)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--gate expects 'auto', a fraction like 0.5, or a step index, "
+            f"got {s!r}")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="p2p_tpu", description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -441,6 +458,15 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--scheduler", choices=("ddim", "plms", "dpm"), default="ddim")
         sp.add_argument("--seeds", type=_int_list, default=[8191],
                         help="comma-separated seed sweep")
+        sp.add_argument("--gate", type=_gate_spec, default=None,
+                        metavar="AUTO|FRAC|STEP",
+                        help="phase-gated sampling: steps past the gate run "
+                             "a single-branch U-Net (CFG folded into a "
+                             "fixed extrapolation) with cached "
+                             "cross-attention — 'auto' picks max(T/2, the "
+                             "controller's edit-window end); 0.5 gates at "
+                             "half the steps; an integer is an absolute "
+                             "step. Omit for exact (ungated) sampling")
 
     def edit_opts(sp):
         sp.add_argument("--mode", choices=("replace", "refine"),

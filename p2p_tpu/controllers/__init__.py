@@ -7,6 +7,7 @@ from .base import (
     apply_step_callback,
     average_attention,
     build_layout,
+    controller_step_window,
     empty_store_state,
     init_store_state,
 )
@@ -26,7 +27,8 @@ from .factory import (
 __all__ = [
     "AttnLayout", "AttnMeta", "Controller", "StoreConfig",
     "apply_attention_control", "apply_step_callback", "average_attention",
-    "build_layout", "empty_store_state", "init_store_state",
+    "build_layout", "controller_step_window", "empty_store_state",
+    "init_store_state",
     "BlendParams", "apply_local_blend",
     "EditParams", "edit_cross_attention", "edit_self_attention",
     "attention_refine", "attention_replace", "attention_reweight",

@@ -58,6 +58,10 @@ class AttnMeta:
     heads: int
     key_len: int            # K (= 77 for cross, = resolution² for self)
     store_slot: Optional[int] = None  # index into the store state, or None
+    # Feature-map channel count at this site (= the attention output width).
+    # 0 in hand-built layouts that predate it; required (> 0) only by the
+    # phase-2 cross-attention cache, which needs output shapes up front.
+    channels: int = 0
 
     @property
     def pixels(self) -> int:
@@ -112,16 +116,21 @@ class AttnLayout:
 
 
 def build_layout(
-    specs: Sequence[Tuple[str, bool, int, int, int]],
+    specs: Sequence[Tuple],
     store_cfg: StoreConfig = StoreConfig(),
 ) -> AttnLayout:
     """Assemble an :class:`AttnLayout` from ``(place, is_cross, resolution,
-    heads, key_len)`` tuples in call order, assigning store slots to the sites
-    the :class:`StoreConfig` wants."""
+    heads, key_len[, channels])`` tuples in call order, assigning store slots
+    to the sites the :class:`StoreConfig` wants. The optional 6th element is
+    the site's feature-map channel count (needed by the phase-2 attention
+    cache); 5-tuples remain valid and get ``channels=0``."""
     metas = []
     slot = 0
-    for idx, (place, is_cross, resolution, heads, key_len) in enumerate(specs):
-        meta = AttnMeta(idx, place, is_cross, resolution, heads, key_len)
+    for idx, spec in enumerate(specs):
+        place, is_cross, resolution, heads, key_len = spec[:5]
+        channels = spec[5] if len(spec) > 5 else 0
+        meta = AttnMeta(idx, place, is_cross, resolution, heads, key_len,
+                        channels=channels)
         if store_cfg.wants(meta):
             meta = dataclasses.replace(meta, store_slot=slot)
             slot += 1
@@ -181,6 +190,49 @@ def controller_touches(controller: Optional["Controller"], meta: AttnMeta) -> bo
             return True
         return meta.pixels <= controller.edit.self_max_pixels
     return False
+
+
+def controller_step_window(controller: Optional["Controller"],
+                           num_steps: int) -> int:
+    """Host-side: the last scan step (exclusive) at which this controller can
+    still *modify* the trajectory through its attention hooks — the max over
+    the cross-replace schedule's support, the self-injection window end, and
+    the SpatialReplace injection horizon.
+
+    This is the floor for phase-gated sampling's ``gate='auto'``: truncating
+    CFG/cross-attention before this step would cut inside an active edit
+    window and change P2P semantics, so the auto gate never resolves below
+    it. Reads concrete (host-side) controller leaves — controllers are built
+    host-side, so calling this on traced values is a usage error. Leaves
+    stacked with a leading sweep/group axis (``parallel.sweep``) are handled:
+    the window is the max over the stacked controllers.
+
+    ``needs_store`` guard: a LocalBlend past this window keeps compositing
+    latents in phase 2 from the *frozen* phase-1 store (accumulation stops at
+    the gate — the maps it masks with are the phase-1 average, which is also
+    what the reference's late steps are dominated by); an explicit
+    ``store=True`` (observability) controller under-accumulates when gated —
+    the engine warns rather than errors, since stores don't alter sampling.
+    """
+    if controller is None or controller.is_identity:
+        return 0
+    import numpy as np
+
+    end = 0
+    if controller.edit is not None:
+        ca = np.asarray(controller.edit.cross_alpha)
+        # cross_alpha is (T+1, E, 1, 1, L), or (G, T+1, ...) when stacked for
+        # a sweep: the step axis is ndim-5. Support of the blend schedule =
+        # steps where any token still draws from the transformed base.
+        step_axis = ca.ndim - 5
+        other = tuple(i for i in range(ca.ndim) if i != step_axis)
+        nz = np.nonzero(np.any(ca != 0, axis=other))[0]
+        if nz.size:
+            end = max(end, int(nz[-1]) + 1)
+        end = max(end, int(np.max(np.asarray(controller.edit.self_end))))
+    if controller.spatial_stop_inject is not None:
+        end = max(end, int(np.max(np.asarray(controller.spatial_stop_inject))))
+    return min(end, num_steps)
 
 
 StoreState = Tuple[jax.Array, ...]

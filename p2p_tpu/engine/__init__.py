@@ -1,7 +1,14 @@
 """Sampling and inversion engines."""
 
 from .inversion import InversionArtifact, invert, load_image
-from .sampler import Pipeline, encode_prompts, init_latent, text2image
+from .sampler import (
+    Pipeline,
+    encode_prompts,
+    init_latent,
+    resolve_gate,
+    text2image,
+)
 
 __all__ = ["InversionArtifact", "invert", "load_image",
-           "Pipeline", "encode_prompts", "init_latent", "text2image"]
+           "Pipeline", "encode_prompts", "init_latent", "resolve_gate",
+           "text2image"]

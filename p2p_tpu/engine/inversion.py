@@ -229,16 +229,31 @@ def invert(
     dtype=jnp.float32,
     progress: bool = False,
     sp=None,
+    gate=None,
 ) -> InversionArtifact:
     """Full null-text inversion (`/root/reference/null_text.py:608-618`):
     DDIM-invert with guidance 1, then optimize per-step uncond embeddings so
     CFG sampling at full guidance reproduces the input image.
+
+    ``gate`` exists only to force the phase-gating decision explicitly: the
+    null-text procedure optimizes a *per-step* uncond embedding at every DDIM
+    step, so CFG truncation (``gate < T``) has no valid interpretation here —
+    any value other than ``None``/``num_steps`` is rejected. Replays of the
+    artifact are likewise gate-free (``text2image`` rejects ``gate`` whenever
+    ``uncond_embeddings`` are active).
 
     ``sp`` (a :class:`p2p_tpu.models.unet.SpConfig`) shards large
     self-attention sites with ring attention through both compiled
     programs — including the optimization's gradient, which recomputes
     ring-flash blocks through the einsum VJP (`parallel/ring.py`). The
     long-context path for inverting high-resolution images."""
+    if gate is not None and gate != num_steps:
+        raise ValueError(
+            f"null-text inversion is incompatible with phase-gated sampling "
+            f"(gate={gate!r}): the optimization targets a per-step uncond "
+            "embedding at every DDIM step, which CFG truncation would drop. "
+            "Run invert() with gate=None; apply --gate to plain "
+            "generation/editing only.")
     cfg = pipe.config
     gs = jnp.asarray(cfg.guidance_scale if guidance_scale is None else guidance_scale,
                      jnp.float32)

@@ -38,12 +38,13 @@ from ..controllers.base import (
     Controller,
     StoreState,
     apply_step_callback,
+    controller_step_window,
     init_store_state,
 )
 from ..models import vae as vae_mod
 from ..models.config import PipelineConfig
 from ..models.text_encoder import apply_text_encoder
-from ..models.unet import apply_unet
+from ..models.unet import apply_unet, init_attn_cache
 from ..ops import schedulers as sched_mod
 from ..utils import progress as progress_mod
 from ..utils.tokenizer import Tokenizer, pad_ids
@@ -94,6 +95,60 @@ def init_latent(latent: Optional[jax.Array], shape: Tuple[int, ...], rng: jax.Ar
     return latent, latents
 
 
+def resolve_gate(gate, num_scan_steps: int,
+                 controller: Optional[Controller] = None) -> int:
+    """Resolve a user-facing ``gate`` spec to a static scan-step index.
+
+    ``None`` (or the full step count) disables phase-gated sampling. A float
+    in ``(0, 1]`` is a fraction of the scan length; an int is the scan step
+    where phase 2 begins (≥ 1 — the cache needs at least one phase-1 step).
+    ``'auto'`` resolves to ``max(S // 2, controller edit-window end, 1)`` —
+    the SD-Acc midpoint, but never truncating inside an active edit window
+    (`controllers.base.controller_step_window`).
+    """
+    s = num_scan_steps
+    if gate is None:
+        return s
+    if gate == "auto":
+        return min(s, max(s // 2, controller_step_window(controller, s), 1))
+    if isinstance(gate, float):
+        if not 0.0 < gate <= 1.0:
+            raise ValueError(f"fractional gate must be in (0, 1], got {gate}")
+        g = int(round(gate * s))
+    elif isinstance(gate, int):
+        g = gate
+    else:
+        raise ValueError(f"gate must be None, 'auto', a float fraction or an "
+                         f"int step, got {gate!r}")
+    if not 1 <= g <= s:
+        raise ValueError(f"gate step {g} outside [1, {s}]")
+    return g
+
+
+def warn_gate_truncation(gate_step: int, num_scan: int,
+                         controller: Optional[Controller]) -> None:
+    """Warn when an explicit gate changes controller semantics: truncating
+    inside an active edit window, or freezing an explicit attention store.
+    Shared by the sequential (``text2image``) and batched (``sweep``) paths
+    so both surfaces report the same conditions the same way."""
+    if gate_step >= num_scan:
+        return
+    import warnings
+
+    window = controller_step_window(controller, num_scan)
+    if gate_step < window:
+        warnings.warn(
+            f"gate step {gate_step} truncates inside the controller's "
+            f"edit window (ends at {window}): attention edits past the "
+            "gate are dropped. Use gate='auto' to clamp to the window.",
+            stacklevel=3)
+    if controller is not None and controller.store:
+        warnings.warn(
+            f"gate step {gate_step} < {num_scan}: the attention store "
+            "stops accumulating at the gate, so averaged maps cover "
+            "phase 1 only", stacklevel=3)
+
+
 def _denoise_scan(
     unet_params: Any,
     cfg: PipelineConfig,
@@ -107,25 +162,59 @@ def _denoise_scan(
     uncond_per_step: Optional[jax.Array] = None,  # (T, 1, L, D) null-text embeddings
     progress: bool = False,
     sp: Optional["SpConfig"] = None,
+    gate: Optional[int] = None,    # static: first phase-2 scan step; None/S = off
 ) -> Tuple[jax.Array, StoreState]:
-    """Scan over timesteps. Returns (final latents, final store state)."""
+    """Scan over timesteps. Returns (final latents, final store state).
+
+    ``gate`` splits the scan into two phases (TAD arXiv 2404.02747 + SD-Acc
+    arXiv 2507.01309, mapped onto P2P's explicit step windows):
+
+    - phase 1 (steps ``0..gate``): the batch-doubled CFG U-Net with full
+      controller hooks, capturing every cross-attention output and the CFG
+      residual ``ε_text − ε_uncond`` (each overwritten per step, so the final
+      carry holds the last phase-1 step's values);
+    - phase 2 (steps ``gate..S``): a single-branch U-Net — no uncond half,
+      guidance folded into a fixed extrapolation off the captured residual,
+      cross-attention replaced by the cached outputs. The controller is
+      dropped at the U-Net level (edit windows end before the gate under
+      ``gate='auto'``); its latent-space step callback (LocalBlend /
+      SpatialReplace) still runs against the frozen phase-1 store.
+
+    ``gate=None`` (or ``gate == S``) compiles the exact pre-existing
+    single-scan program — bitwise-identical output, zero new ops.
+    """
     b = latents.shape[0]
     state = (init_store_state(layout, b, dtype=jnp.float32)
              if (controller is not None and controller.needs_store) else ())
 
     use_plms = scheduler_kind == "plms"
     use_dpm = scheduler_kind == "dpm"
-    # Multistep-solver state carried through the scan (PLMS ring buffer or
-    # DPM x0 history; None for single-step DDIM).
-    if use_plms:
-        ms_state = sched_mod.init_plms_state(latents.shape, latents.dtype)
-    elif use_dpm:
-        ms_state = sched_mod.init_dpm_state(latents.shape, latents.dtype)
-    else:
-        ms_state = None
+    # Multistep-solver state carried through the scan — and, when gated,
+    # across the phase boundary (PLMS ring buffer or DPM x0 history; None
+    # for single-step DDIM).
+    ms_state = sched_mod.init_multistep_state(scheduler_kind, latents.shape,
+                                              latents.dtype)
+    num_scan = schedule.timesteps.shape[0]
+    if gate is None:
+        gate = num_scan
+    assert 1 <= gate <= num_scan, (gate, num_scan)
+    gated = gate < num_scan
+    if gated and uncond_per_step is not None:
+        raise ValueError("phase-gated sampling cannot run under per-step "
+                         "null-text uncond embeddings (validated upstream)")
 
-    def body(carry, scan_in):
-        latents, state, ms = carry
+    def ms_step(ms, eps, t, latents):
+        if use_plms:
+            return sched_mod.plms_step(schedule, ms, eps, t, latents)
+        if use_dpm:
+            return sched_mod.dpm_step(schedule, ms, eps, t, latents)
+        return ms, sched_mod.ddim_step(schedule, eps, t, latents)
+
+    def body(carry, scan_in, capture: bool):
+        if capture:
+            latents, state, ms, cache, resid = carry
+        else:
+            latents, state, ms = carry
         step, t = scan_in
         progress_mod.emit_step(progress, step)
         ctx = context
@@ -139,32 +228,83 @@ def _denoise_scan(
                                                     context[:b].shape),
                                    context[b:]], axis=0)
         latent_in = jnp.concatenate([latents] * 2, axis=0)
-        eps, state = apply_unet(
-            unet_params, cfg.unet, latent_in, t, ctx,
-            layout=layout, controller=controller, state=state, step=step,
-            sp=sp)
+        if capture:
+            eps, state, cache = apply_unet(
+                unet_params, cfg.unet, latent_in, t, ctx,
+                layout=layout, controller=controller, state=state, step=step,
+                sp=sp, attn_cache=cache, cache_mode="store")
+        else:
+            eps, state = apply_unet(
+                unet_params, cfg.unet, latent_in, t, ctx,
+                layout=layout, controller=controller, state=state, step=step,
+                sp=sp)
         eps_uncond, eps_text = eps[:b], eps[b:]
-        eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
+        if capture:
+            resid = eps_text - eps_uncond
+            eps = eps_uncond + guidance_scale * resid
+        else:
+            eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
         # v-prediction models (SD-2.1 768-v): convert to ε once per step.
         # Linear in the model output, so combining CFG first is equivalent.
         eps = sched_mod.to_epsilon(schedule, eps, t, latents)
-        if use_plms:
-            ms, latents = sched_mod.plms_step(schedule, ms, eps, t, latents)
-        elif use_dpm:
-            ms, latents = sched_mod.dpm_step(schedule, ms, eps, t, latents)
-        else:
-            latents = sched_mod.ddim_step(schedule, eps, t, latents)
+        ms, latents = ms_step(ms, eps, t, latents)
         latents = apply_step_callback(controller, layout, state, latents, step)
+        if capture:
+            return (latents, state, ms, cache, resid), None
         return (latents, state, ms), None
 
-    steps = jnp.arange(schedule.timesteps.shape[0], dtype=jnp.int32)
-    (latents, state, _), _ = jax.lax.scan(
-        body, (latents, state, ms_state), (steps, schedule.timesteps))
+    steps = jnp.arange(num_scan, dtype=jnp.int32)
+    if not gated:
+        # Feature off: the exact pre-existing program (no cache buffers, no
+        # residual carry) — gate=S is bitwise-identical by construction.
+        (latents, state, _), _ = jax.lax.scan(
+            partial(body, capture=False), (latents, state, ms_state),
+            (steps, schedule.timesteps))
+        return latents, state
+
+    # Phase 1: CFG + hooks + capture. Latent math is identical to the ungated
+    # body (the capture only adds carry writes), so phase-1 latents match the
+    # baseline bitwise.
+    cache = init_attn_cache(layout, b, dtype=latents.dtype)
+    resid = jnp.zeros_like(latents)
+    (latents, state, ms_state, cache, resid), _ = jax.lax.scan(
+        partial(body, capture=True),
+        (latents, state, ms_state, cache, resid),
+        (steps[:gate], schedule.timesteps[:gate]))
+
+    # Slice the conditional context half once, outside the phase-2 body: a
+    # slice inside the scan would pull the full [uncond; cond] tensor into
+    # the body as a constant — the uncond half must not even be an input.
+    context_cond = context[b:]
+
+    def body2(carry, scan_in):
+        latents, ms = carry
+        step, t = scan_in
+        progress_mod.emit_step(progress, step)
+        eps_text, _ = apply_unet(
+            unet_params, cfg.unet, latents, t, context_cond,
+            layout=layout, controller=None, state=(), step=step, sp=sp,
+            attn_cache=cache, cache_mode="use")
+        # SD-Acc-style fixed extrapolation: CFG's uncond branch is gone;
+        # ε = ε_text + (g−1)·(ε_text − ε_uncond)|_gate reuses the captured
+        # last-phase-1 residual as the guidance direction.
+        eps = eps_text + (guidance_scale - 1.0) * resid
+        eps = sched_mod.to_epsilon(schedule, eps, t, latents)
+        ms, latents = ms_step(ms, eps, t, latents)
+        # Latent-space controller effects (LocalBlend compositing /
+        # SpatialReplace injection) continue against the frozen phase-1
+        # store; attention hooks are structurally gone.
+        latents = apply_step_callback(controller, layout, state, latents, step)
+        return (latents, ms), None
+
+    (latents, _), _ = jax.lax.scan(
+        body2, (latents, ms_state),
+        (steps[gate:], schedule.timesteps[gate:]))
     return latents, state
 
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
-                                   "return_store", "progress", "sp"))
+                                   "return_store", "progress", "sp", "gate"))
 def _text2image_jit(
     unet_params: Any,
     vae_params: Any,
@@ -181,11 +321,13 @@ def _text2image_jit(
     return_store: bool,
     progress: bool = False,
     sp: Optional["SpConfig"] = None,
+    gate: Optional[int] = None,
 ):
     context = jnp.concatenate([context_uncond, context_cond], axis=0)
     latents, state = _denoise_scan(
         unet_params, cfg, layout, schedule, scheduler_kind, context, latents,
-        controller, guidance_scale, uncond_per_step, progress=progress, sp=sp)
+        controller, guidance_scale, uncond_per_step, progress=progress, sp=sp,
+        gate=gate)
     image = vae_mod.decode(vae_params, cfg.vae, latents.astype(jnp.float32))
     image = vae_mod.to_uint8(image)
     return (image, latents, state) if return_store else (image, latents, ())
@@ -208,6 +350,7 @@ def text2image(
     return_store: bool = False,
     progress: bool = False,
     sp: Optional["SpConfig"] = None,
+    gate=None,
 ):
     """Generate an edit group of images from prompts under attention control —
     the `/root/reference/ptp_utils.py:129-172` entry point.
@@ -220,7 +363,17 @@ def text2image(
     ``uncond_embeddings``. ``sp`` (a :class:`p2p_tpu.models.unet.SpConfig`)
     shards the pixel axis of large untouched self-attention sites over a
     mesh axis with ring attention — the long-context scaling axis (image
-    resolution; SURVEY §5) the reference lacks entirely. Returns
+    resolution; SURVEY §5) the reference lacks entirely.
+
+    ``gate`` enables phase-gated sampling (see :func:`resolve_gate`): steps
+    past the gate run a single-branch U-Net (no CFG uncond half) with every
+    cross-attention site served from the cached last-phase-1-step output —
+    the per-step cost drops roughly in half past the gate at a small,
+    bounded drift (PERF.md "Beyond the XLA ceiling"). ``gate=None`` (or the
+    full step count) is bitwise-identical to ungated sampling. Incompatible
+    with ``uncond_embeddings``: the null-text artifact optimizes the uncond
+    branch at *every* step, so truncating it would silently misalign the
+    replay — rejected with an error instead. Returns
     ``(images uint8 (B,H,W,3), x_T, store)``.
     """
     if negative_prompt and uncond_embeddings is not None:
@@ -250,6 +403,19 @@ def text2image(
 
     schedule = sched_mod.schedule_from_config(num_steps, cfg.scheduler,
                                               kind=scheduler)
+    num_scan = schedule.timesteps.shape[0]
+    gate_step = resolve_gate(gate, num_scan, controller)
+    if gate_step < num_scan and uncond_embeddings is not None:
+        # The null-text window spans every step (validated (T,1,L,D)
+        # above): any gate < T truncates inside it. Reject loudly — a
+        # silently misaligned replay looks plausible and is wrong.
+        raise ValueError(
+            f"gate={gate!r} (step {gate_step}) conflicts with per-step "
+            f"null-text uncond_embeddings, which are active through all "
+            f"{num_scan} steps: CFG truncation would drop the optimized "
+            "uncond branch mid-window. Run null-text replays with "
+            "gate=None.")
+    warn_gate_truncation(gate_step, num_scan, controller)
     context_cond = encode_prompts(pipe, prompts, dtype=dtype)
     context_uncond = encode_prompts(
         pipe, [negative_prompt or ""] * len(prompts), dtype=dtype)
@@ -260,5 +426,6 @@ def text2image(
     image, latents_out, state = _text2image_jit(
         pipe.unet_params, pipe.vae_params, cfg, layout, schedule, scheduler,
         context_cond, context_uncond, latents, controller, gs,
-        uncond_embeddings, return_store, progress=progress, sp=sp)
+        uncond_embeddings, return_store, progress=progress, sp=sp,
+        gate=gate_step)
     return image, x_t, state

@@ -79,21 +79,24 @@ TINY_UNET = UNetConfig(
 
 def unet_attn_specs(cfg: UNetConfig):
     """Every attention call site in forward-call order, as
-    ``(place, is_cross, resolution, heads, key_len)`` tuples.
+    ``(place, is_cross, resolution, heads, key_len, channels)`` tuples.
 
     Order contract (must match ``unet.apply_unet``'s call order): down blocks
     (per transformer block: self then cross), mid, up blocks. For SD14_UNET
     this yields exactly the reference's 32 hooked sites with the store slice
     ``down_cross[2:4] + up_cross[:3]`` landing on the 16×16 cross maps
-    (`/root/reference/main.py:37-38`)."""
+    (`/root/reference/main.py:37-38`). ``channels`` (the site's feature-map
+    width = its attention output width) sizes the phase-2 cross-attention
+    cache buffers before tracing."""
     specs = []
 
     def site(place, level):
         res = cfg.resolution_at(level)
-        heads = cfg.heads_for(cfg.block_channels[level])
+        ch = cfg.block_channels[level]
+        heads = cfg.heads_for(ch)
         for _ in range(cfg.transformer_depth):
-            specs.append((place, False, res, heads, res * res))       # self
-            specs.append((place, True, res, heads, cfg.context_len))  # cross
+            specs.append((place, False, res, heads, res * res, ch))       # self
+            specs.append((place, True, res, heads, cfg.context_len, ch))  # cross
 
     for level in range(cfg.levels):                      # down
         if cfg.attn_levels[level]:

@@ -170,20 +170,60 @@ def _apply_resnet(p: Params, x: jax.Array, temb: jax.Array, groups: int) -> jax.
     return x + h
 
 
+# Phase-gated sampling's cross-attention cache: one ``(B_cond, P, C)`` array
+# per cross site in call order — the attn2 *output* (post-``to_out``) of the
+# conditional batch half, captured on the last phase-1 step. Consuming it in
+# phase 2 removes the whole q/k/v-projection + softmax(QKᵀ)V + ``to_out``
+# pipeline of every cross site from the compiled program (TAD, arXiv
+# 2404.02747: cross-attention outputs converge after an early gate step).
+AttnCache = Tuple[jax.Array, ...]
+
+
+def init_attn_cache(layout: AttnLayout, batch_cond: int,
+                    dtype=jnp.float32) -> AttnCache:
+    """Zero-initialized cache buffers for every cross-attention site.
+
+    Requires a layout whose metas carry ``channels`` (built from
+    ``unet_attn_specs``); hand-built 5-tuple layouts can't size the buffers.
+    """
+    caches = []
+    for m in layout.metas:
+        if not m.is_cross:
+            continue
+        if m.channels <= 0:
+            raise ValueError(
+                f"cross site {m.layer_idx} has no channel info "
+                "(layout built from 5-tuple specs); the attention cache "
+                "needs channels — rebuild the layout via unet_attn_specs")
+        caches.append(jnp.zeros((batch_cond, m.pixels, m.channels), dtype))
+    return tuple(caches)
+
+
 class _HookCtx:
     """Trace-time cursor over the attention layout, carrying the controller
     store state through the sites in call order. ``sp`` optionally names a
-    mesh axis for sequence-parallel (ring) self-attention at large sites."""
+    mesh axis for sequence-parallel (ring) self-attention at large sites.
+
+    ``cache_mode`` is the phase-gated sampling switch (static, so each mode
+    compiles its own program): ``'off'`` — no cache interaction; ``'store'``
+    — compute every site normally and overwrite the cache slot of each cross
+    site with its conditional-half output; ``'use'`` — cross sites return
+    their cached output directly, computing nothing."""
 
     def __init__(self, layout: AttnLayout, controller: Optional[Controller],
                  state: StoreState, step: jax.Array,
-                 sp: Optional["SpConfig"] = None):
+                 sp: Optional["SpConfig"] = None,
+                 attn_cache: Optional[AttnCache] = None,
+                 cache_mode: str = "off"):
         self.layout = layout
         self.controller = controller
         self.state = state
         self.step = step
         self.sp = sp
         self.cursor = 0
+        self.attn_cache = attn_cache
+        self.cache_mode = cache_mode
+        self.cross_cursor = 0
 
     def next_meta(self):
         meta = self.layout.metas[self.cursor]
@@ -223,6 +263,19 @@ def _apply_attention(p: Params, x: jax.Array, context: jax.Array, heads: int,
     assert meta.is_cross == is_cross, (
         f"layout order mismatch at site {meta.layer_idx}: layout says "
         f"is_cross={meta.is_cross}, model called is_cross={is_cross}")
+
+    if is_cross and ctx.cache_mode == "use":
+        # Phase 2 of gated sampling: the text context is untouched past the
+        # gate, so this site's output is the cached last-phase-1-step tensor.
+        # Returning it here removes q/k/v, softmax(QKᵀ)V and to_out for the
+        # site from the compiled program entirely.
+        cached = ctx.attn_cache[ctx.cross_cursor]
+        ctx.cross_cursor += 1
+        assert cached.shape == (x.shape[0], x.shape[1], x.shape[2]), (
+            f"attn cache shape {cached.shape} does not match site "
+            f"{meta.layer_idx} input {x.shape} — was the cache captured at a "
+            "different batch/resolution?")
+        return cached
 
     b, pix, _ = x.shape
     src = context if is_cross else x
@@ -290,7 +343,16 @@ def _apply_attention(p: Params, x: jax.Array, context: jax.Array, heads: int,
         out = nn.fused_attention(q, k, v, scale)
 
     out = out.transpose(0, 2, 1, 3).reshape(b, pix, heads * d_head)
-    return nn.linear(p["to_out"], out)
+    out = nn.linear(p["to_out"], out)
+    if is_cross and ctx.cache_mode == "store":
+        # Capture the conditional half of the CFG-doubled batch (rows B:).
+        # Overwritten every step, so after the phase-1 scan the cache holds
+        # exactly the last phase-1 step's outputs — no per-step select.
+        lst = list(ctx.attn_cache)
+        lst[ctx.cross_cursor] = out[out.shape[0] // 2:]
+        ctx.attn_cache = tuple(lst)
+        ctx.cross_cursor += 1
+    return out
 
 
 def _apply_transformer_block(p: Params, x: jax.Array, context: jax.Array,
@@ -332,19 +394,54 @@ def apply_unet(
     state: StoreState = (),
     step: Optional[jax.Array] = None,
     sp: Optional[SpConfig] = None,
-) -> Tuple[jax.Array, StoreState]:
-    """Predict ε(x_t, t, context). Returns ``(eps, controller_store_state)``.
+    attn_cache: Optional[AttnCache] = None,
+    cache_mode: str = "off",
+):
+    """Predict ε(x_t, t, context). Returns ``(eps, controller_store_state)``,
+    plus the updated cache as a third element iff ``cache_mode='store'``.
 
     With ``controller=None`` this is a plain conditional U-Net forward and the
     returned state is the input state — the `EmptyControl ≡ no controller`
     equivalence holds at the XLA-program level. ``sp`` enables ring
     (sequence-parallel) attention for large untouched self sites.
+
+    ``cache_mode`` (static) is phase-gated sampling's switch over the
+    cross-attention cache ``attn_cache`` (one ``(B_cond, P, C)`` leaf per
+    cross site): ``'store'`` runs the normal CFG-doubled forward and
+    overwrites each cross slot with the site's conditional-half output;
+    ``'use'`` runs the single-branch (no uncond half) forward with every
+    cross site replaced by its cached output — a genuinely smaller program.
+    ``'use'`` is incompatible with an active controller: cross edits and
+    stores read the probability tensor, which no longer exists.
     """
+    if cache_mode not in ("off", "store", "use"):
+        raise ValueError(f"unknown cache_mode {cache_mode!r} "
+                         "(expected 'off', 'store' or 'use')")
     if layout is None:
         layout = unet_layout(cfg)
+    if cache_mode != "off":
+        n_cross = sum(1 for m in layout.metas if m.is_cross)
+        if attn_cache is None or len(attn_cache) != n_cross:
+            raise ValueError(
+                f"cache_mode={cache_mode!r} needs an attn_cache with one "
+                f"entry per cross site ({n_cross}), got "
+                f"{None if attn_cache is None else len(attn_cache)}")
+    if cache_mode == "use" and controller is not None \
+            and not controller.is_identity:
+        # The needs_store/edit guard: a controller's cross hooks need the
+        # materialized probability tensor, which the cached path never
+        # computes. Gate resolution ('auto') keeps edit windows inside
+        # phase 1; phase 2 must drop the controller at the U-Net level and
+        # apply only the latent-space step callback with the frozen store.
+        raise ValueError(
+            "cache_mode='use' cannot run with an active controller: "
+            "cross-attention probabilities are not computed in phase 2 — "
+            "pass controller=None and keep controller effects to "
+            "apply_step_callback")
     if step is None:
         step = jnp.int32(0)
-    ctx = _HookCtx(layout, controller, state, step, sp=sp)
+    ctx = _HookCtx(layout, controller, state, step, sp=sp,
+                   attn_cache=attn_cache, cache_mode=cache_mode)
     g = cfg.groups
 
     t = jnp.broadcast_to(jnp.asarray(t), (x.shape[0],))
@@ -385,4 +482,6 @@ def apply_unet(
 
     h = nn.silu(nn.group_norm(params["norm_out"], h, g))
     eps = nn.conv2d(params["conv_out"], h)
+    if cache_mode == "store":
+        return eps, ctx.state, ctx.attn_cache
     return eps, ctx.state

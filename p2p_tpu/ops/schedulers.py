@@ -290,6 +290,24 @@ def plms_step(
     )
 
 
+def init_multistep_state(kind: str, sample_shape: Tuple[int, ...],
+                         dtype=jnp.float32):
+    """The scan-carried multistep state for scheduler ``kind`` (None for the
+    single-step DDIM). One constructor so phase-gated sampling initializes it
+    once and hands the SAME carry across the phase boundary: the PLMS ε ring
+    buffer / DPM x0 history holds CFG-combined ε-space values, which phase 2's
+    extrapolated-guidance ε continues seamlessly — re-initializing at the gate
+    would re-enter the low-order warm-up mid-trajectory and visibly kink the
+    integration."""
+    if kind == "plms":
+        return init_plms_state(sample_shape, dtype)
+    if kind == "dpm":
+        return init_dpm_state(sample_shape, dtype)
+    if kind == "ddim":
+        return None
+    raise ValueError(f"unknown scheduler kind: {kind!r}")
+
+
 # ---------------------------------------------------------------------------
 # DPM-Solver++(2M) — beyond the reference: a second-order multistep solver
 # (Lu et al., arXiv 2211.01095) that reaches 50-step-DDIM quality in ~20-25

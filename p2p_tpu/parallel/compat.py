@@ -1,0 +1,24 @@
+"""jax version compatibility shims for the parallel layer.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` (≤0.4.x, where
+the replication checker is the ``check_rep`` kwarg) to ``jax.shard_map``
+(where it is ``check_vma``). The repo targets the modern surface; this shim
+keeps the sequence-parallel paths (and their tier-1 tests) alive on the
+0.4.x runtime the container ships.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` when available, else the 0.4.x experimental one
+    (``check_vma`` mapped onto its ``check_rep``)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)

@@ -21,14 +21,14 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..controllers.base import AttnLayout, Controller
-from ..engine.sampler import _denoise_scan
+from ..engine.sampler import _denoise_scan, resolve_gate, warn_gate_truncation
 from ..models import vae as vae_mod
 from ..models.config import PipelineConfig
 from ..ops import schedulers as sched_mod
 
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
-                                   "progress"),
+                                   "progress", "gate"),
          donate_argnums=())
 def _sweep_jit(
     unet_params: Any,
@@ -43,6 +43,7 @@ def _sweep_jit(
     guidance_scale: jax.Array,
     uncond_per_step: Optional[jax.Array],  # (G, T, 1, L, D) or None
     progress: bool = False,
+    gate: Optional[int] = None,
 ):
     def one_group(ctx, lat, ctrl, ups):
         # The scanned step index is vmap-invariant (built inside the scan,
@@ -50,7 +51,7 @@ def _sweep_jit(
         # once per step — not once per group.
         lat, state = _denoise_scan(
             unet_params, cfg, layout, schedule, scheduler_kind, ctx, lat, ctrl,
-            guidance_scale, uncond_per_step=ups, progress=progress)
+            guidance_scale, uncond_per_step=ups, progress=progress, gate=gate)
         image = vae_mod.decode(vae_params, cfg.vae, lat.astype(jnp.float32))
         return vae_mod.to_uint8(image), lat
 
@@ -70,6 +71,7 @@ def sweep(
     mesh: Optional[Mesh] = None,
     uncond_per_step: Optional[jax.Array] = None,
     progress: bool = False,
+    gate=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Run G independent edit groups; shard the group axis over ``dp``.
 
@@ -85,6 +87,10 @@ def sweep(
     image's edit sweep rides the same zero-collective dp engine as a seed
     sweep (the missing-notebook workflow, `/root/reference/null_text.py:618`
     + SURVEY §3.2, at mesh scale). DDIM-only, like the sequential path.
+    ``gate`` enables phase-gated sampling exactly as in ``text2image``
+    (``engine.sampler.resolve_gate`` semantics; ``'auto'`` resolves against
+    the stacked controllers' max edit window); incompatible with
+    ``uncond_per_step`` for the same null-text-window reason.
     Negative-prompt contexts need no parameter here: the uncond rows of
     ``context`` are caller-encoded, so a per-group negative prompt is just
     a different uncond half. ``progress=True`` reports per-step progress
@@ -111,6 +117,16 @@ def sweep(
                 f"sampling uses {num_steps}")
     schedule = sched_mod.schedule_from_config(num_steps, cfg.scheduler,
                                               kind=scheduler)
+    gate_step = resolve_gate(gate, schedule.timesteps.shape[0], controllers)
+    if gate_step < schedule.timesteps.shape[0] and uncond_per_step is not None:
+        raise ValueError(
+            f"gate={gate!r} conflicts with per-step null-text uncond "
+            "embeddings (active through every step): run null-text replay "
+            "sweeps with gate=None")
+    # Same surfaced semantics as the sequential path: an explicit gate that
+    # truncates edit windows / freezes an explicit store must not be
+    # silent just because the run is batched.
+    warn_gate_truncation(gate_step, schedule.timesteps.shape[0], controllers)
     gs = jnp.asarray(guidance_scale, jnp.float32)
 
     if mesh is not None:
@@ -131,7 +147,7 @@ def sweep(
 
     return _sweep_jit(pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
                       scheduler, context, latents, controllers, gs,
-                      uncond_per_step, progress=progress)
+                      uncond_per_step, progress=progress, gate=gate_step)
 
 
 def artifact_replay_inputs(pipe, x_t, uncond_embeddings, source: str,

@@ -16,29 +16,41 @@ import hashlib
 import os
 import sys
 
-import jax
-
 _DEFAULT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     ".jax_cache")
 
 
+def default_cache_dir(hash_xla_flags: bool = True) -> str:
+    """The cache directory every entry point (and test conftest/subprocess
+    env) should agree on: a pre-set ``JAX_COMPILATION_CACHE_DIR`` env var
+    verbatim — so CI and multi-checkout machines can share ONE cache instead
+    of each clone growing its own ``.jax_cache`` — else the repo-local
+    default, suffixed with a hash of the ambient ``XLA_FLAGS`` (not every XLA
+    flag reaches the cache key, so two processes with different codegen flags
+    must never reload each other's executables). jax-free, so test conftests
+    can call it before their first ``import jax``."""
+    env_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if env_dir:
+        return env_dir
+    flags = os.environ.get("XLA_FLAGS", "") if hash_xla_flags else ""
+    suffix = ("-" + hashlib.sha256(flags.encode()).hexdigest()[:12]
+              if flags else "")
+    return _DEFAULT_DIR + suffix
+
+
 def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     """Point JAX's persistent compilation cache at ``cache_dir`` (defaults to
+    :func:`default_cache_dir` — a pre-set ``JAX_COMPILATION_CACHE_DIR``, else
     ``<repo>/.jax_cache``, gitignored). Safe to call more than once.
 
-    Not every XLA flag reaches the cache key, so the ambient ``XLA_FLAGS``
-    value is hashed into the directory name — two processes with different
-    codegen flags can never reload each other's executables. The
-    ``JAX_PERSISTENT_CACHE_*`` env knobs are honored when set. The cache is a
-    pure optimization: any failure to set it up is reported and skipped.
+    The ``JAX_PERSISTENT_CACHE_*`` env knobs are honored when set. The cache
+    is a pure optimization: any failure to set it up is reported and skipped.
     """
-    cache_dir = cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    import jax
+
     if cache_dir is None:
-        flags = os.environ.get("XLA_FLAGS", "")
-        suffix = ("-" + hashlib.sha256(flags.encode()).hexdigest()[:12]
-                  if flags else "")
-        cache_dir = _DEFAULT_DIR + suffix
+        cache_dir = default_cache_dir()
     try:
         # Parse everything before the first config.update so the settings
         # apply all-or-nothing (a late parse error must not leave the cache

@@ -21,13 +21,20 @@ import sys
 # *subprocess* a test spawns.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # Persistent compile cache: the suite's cost is dominated by XLA compiles of
 # many distinct tiny programs; caching them on disk makes re-runs (and other
-# processes, e.g. xdist workers) skip compilation entirely.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".jax_cache"))
+# processes, e.g. xdist workers) skip compilation entirely. The directory
+# comes from the one shared resolver (p2p_tpu.utils.cache — importable
+# before jax): a pre-set JAX_COMPILATION_CACHE_DIR is respected verbatim so
+# CI and multi-checkout machines share one cache, else the repo-local
+# default. hash_xla_flags=False keeps the suite's historical directory: the
+# device-count flag appended below doesn't affect codegen, and in-process
+# tests plus their subprocesses must agree on one dir.
+from p2p_tpu.utils.cache import default_cache_dir  # noqa: E402 (pre-jax)
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      default_cache_dir(hash_xla_flags=False))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 _flags = os.environ.get("XLA_FLAGS", "")

@@ -12,6 +12,8 @@ import sys
 
 import pytest
 
+from p2p_tpu.utils.cache import default_cache_dir
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 EXPECTED_KEYS = {
@@ -19,6 +21,10 @@ EXPECTED_KEYS = {
     "single_group_imgs_per_s",
     "batched_2groups_imgs_per_s", "batched_4groups_imgs_per_s",
     "batched_8groups_imgs_per_s",
+    # Phase-gated variant of the headline config (ISSUE 1): rate plus the
+    # schema keys that let the trajectory split algorithmic vs kernel wins.
+    "batched_4groups_gate05_imgs_per_s", "gate_step", "gate_window_end",
+    "phase1_ms_per_step", "phase2_ms_per_step", "phase2_unet_batch",
     "dpm20_imgs_per_s", "dpm20_batched_8groups_imgs_per_s",
     "dpm20_batched_4groups_imgs_per_s",
     "reweight_eqsweep_4groups_imgs_per_s",
@@ -379,8 +385,11 @@ def test_prof_experiments_tiny_smoke_lane_validates_qkv():
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["P2P_EXP_PRESET"] = "tiny"
+    # One resolver for the whole repo (p2p_tpu.utils.cache): a pre-set
+    # JAX_COMPILATION_CACHE_DIR is respected (shared CI cache), else the
+    # repo-local default the in-process conftest also uses.
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(REPO, ".jax_cache"))
+                   default_cache_dir(hash_xla_flags=False))
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "profiling",
                                       "prof_experiments.py"), "--qkv"],
@@ -444,8 +453,11 @@ def test_bench_rehearsal_green_and_complete():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    # One resolver for the whole repo (p2p_tpu.utils.cache): a pre-set
+    # JAX_COMPILATION_CACHE_DIR is respected (shared CI cache), else the
+    # repo-local default the in-process conftest also uses.
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(REPO, ".jax_cache"))
+                   default_cache_dir(hash_xla_flags=False))
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"),
          "--preset", "rehearse"],

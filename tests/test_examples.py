@@ -12,6 +12,8 @@ import sys
 
 import pytest
 
+from p2p_tpu.utils.cache import default_cache_dir
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 CASES = [
@@ -38,8 +40,11 @@ def _cpu_env():
     # a fresh container where site-packages was reset.
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     # Share the suite's persistent compile cache so re-runs are warm.
+    # One resolver for the whole repo (p2p_tpu.utils.cache): a pre-set
+    # JAX_COMPILATION_CACHE_DIR is respected (shared CI cache), else the
+    # repo-local default the in-process conftest also uses.
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(REPO, ".jax_cache"))
+                   default_cache_dir(hash_xla_flags=False))
     return env
 
 

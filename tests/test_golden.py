@@ -151,13 +151,21 @@ def _case_dpm(tiny):
 GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
 
 # Pinned on CPU (x86-64, f32). Regenerate intentionally — see module docstring.
+# Re-pinned 2026-08-03 on the current CI host: the previous pins came from a
+# different BLAS/ISA and failed here at seed with max|Δ|=255 (both layers),
+# i.e. the golden contract provided no protection at all on the machine that
+# actually runs the suite. Verified independently of the phase-gate refactor:
+# regenerating the goldens from the PRE-change commit (git worktree at the
+# seed HEAD) on this host produced these exact six hashes — the re-pin
+# encodes only the host change, not a numerics change (gate=T bitwise
+# equivalence is additionally proven in tests/test_phase_cache.py).
 GOLDEN = {
-    "replace": "8dde9c1a8d9430af",
-    "refine_blend": "60db370a6ca56bea",
-    "reweight_sweep": "0b45bfcc134a7dda",
-    "nulltext": "2bb2980052c44f63",
-    "ldm": "78f4e49b5a2cb362",
-    "dpm": "93136b89310fc4d9",
+    "replace": "da6bad6676491833",
+    "refine_blend": "6d600ef443051152",
+    "reweight_sweep": "4d19b88a0aff3a1b",
+    "nulltext": "9e288ab1f42a362b",
+    "ldm": "8571b556e5451286",
+    "dpm": "a4962a521ed56b6c",
 }
 
 CASES = {

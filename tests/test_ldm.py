@@ -34,7 +34,7 @@ def test_ldm_unet_per_level_heads():
     """LDM fixes head_dim=64: heads must be 5/10/20 at 320/640/1280 channels."""
     specs = unet_attn_specs(LDM_UNET)
     heads_by_res = {}
-    for place, is_cross, res, heads, key_len in specs:
+    for place, is_cross, res, heads, key_len, channels in specs:
         heads_by_res.setdefault(res, heads)
     assert heads_by_res[32] == 5
     assert heads_by_res[16] == 10

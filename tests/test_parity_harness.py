@@ -13,6 +13,8 @@ import jax
 import numpy as np
 import pytest
 
+from p2p_tpu.utils.cache import default_cache_dir
+
 torch = pytest.importorskip("torch")
 
 from p2p_tpu.engine.sampler import Pipeline
@@ -35,8 +37,11 @@ def _cpu_env():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    # One resolver for the whole repo (p2p_tpu.utils.cache): a pre-set
+    # JAX_COMPILATION_CACHE_DIR is respected (shared CI cache), else the
+    # repo-local default the in-process conftest also uses.
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(REPO, ".jax_cache"))
+                   default_cache_dir(hash_xla_flags=False))
     return env
 
 

@@ -26,7 +26,7 @@ def test_sd21_configs_are_consistent():
     assert SD21.latent_size * 8 == SD21.image_size == 768
     assert SD21_BASE.text.num_layers == 23          # penultimate-layer trick
     assert SD21_BASE.text.activation == "gelu"      # OpenCLIP, not quick_gelu
-    heads = {h for (_, _, _, h, _) in unet_attn_specs(SD21_BASE.unet)}
+    heads = {h for (_, _, _, h, *_) in unet_attn_specs(SD21_BASE.unet)}
     assert heads == {5, 10, 20}                     # head_dim 64
 
 

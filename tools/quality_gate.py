@@ -1,0 +1,146 @@
+"""Golden quality gate: re-run the pinned tiny configs and diff against
+``tests/golden/*.npz``, exiting nonzero on drift.
+
+The golden pytest (tests/test_golden.py) answers "did THIS commit change
+numerics"; this tool is the standalone CI/tooling form of the same contract —
+runnable outside pytest (e.g. as a pre-merge gate or from a perf-tuning
+loop), reporting MSE and max-abs per config, with thresholds on the command
+line. It reuses test_golden's case builders so the two can never drift apart,
+and adds the phase-gate drift check (gated latents vs
+``tests/golden/phase_gate.npz``) so an attention-cache regression fails the
+gate even when ungated sampling is untouched.
+
+    python tools/quality_gate.py                 # all configs, default bounds
+    python tools/quality_gate.py --only replace,dpm --max-abs 3 --mse 0.25
+
+Wired into the suite as a ``slow``-marked pytest
+(tests/test_quality_gate.py) so tier-1 (-m 'not slow') stays fast.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# Force the deterministic CPU backend before any jax import: quality is
+# platform-independent, and the goldens are pinned on CPU (same scrub the
+# test conftest applies).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from p2p_tpu.utils.cache import default_cache_dir  # noqa: E402
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      default_cache_dir(hash_xla_flags=False))
+
+import numpy as np  # noqa: E402
+
+
+def _cases():
+    """test_golden's case registry + the tiny pipeline it runs against."""
+    from tests.test_golden import CASES, GOLDEN_DIR, _pipe
+    from p2p_tpu.models import TINY
+
+    return CASES, GOLDEN_DIR, _pipe(TINY)
+
+
+def _phase_gate_drift():
+    """(mse, max_abs) of gate=0.5T latents vs the ungated latents — the
+    ISSUE 1 drift contract (threshold 1e-2), checked end to end. Mirrors
+    test_phase_cache's foreign-platform fallback: when the in-session
+    ungated run itself disagrees with the pinned npz (different BLAS/ISA
+    than the pinning host), drift is measured against the in-session
+    baseline — the property gated here is what the *gate* introduces, not
+    BLAS portability."""
+    from p2p_tpu.models import TINY
+    from p2p_tpu.parallel import sweep
+    from tests.test_golden import _pipe
+    from tests.test_phase_cache import (
+        GATE, PLATFORM_TOL, STEPS, _sweep_inputs)
+
+    # Reuse the test's exact input builder — the tool must measure the
+    # same trajectory the golden-pinning test pins, or a drift regression
+    # could pass one surface and fail the other.
+    pipe = _pipe(TINY)
+    ctx, lats, ctrls = _sweep_inputs(pipe)
+    _, lat_base = sweep(pipe, ctx, lats, ctrls, num_steps=STEPS)
+    _, lat_gate = sweep(pipe, ctx, lats, ctrls, num_steps=STEPS, gate=GATE)
+    lat_base = np.asarray(lat_base, np.float64)
+    golden = np.load(os.path.join(_REPO, "tests", "golden",
+                                  "phase_gate.npz"))["latents_base"]
+    ref = golden.astype(np.float64)
+    if ((lat_base - ref) ** 2).mean() > PLATFORM_TOL:
+        ref = lat_base
+    d = np.asarray(lat_gate, np.float64) - ref
+    return float((d ** 2).mean()), float(np.abs(d).max())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--only", default=None,
+                    help="comma-separated subset of golden configs")
+    ap.add_argument("--mse", type=float, default=0.25,
+                    help="max image MSE (uint8² units) per config")
+    ap.add_argument("--max-abs", type=float, default=3.0,
+                    help="max per-pixel abs diff (uint8 steps) per config")
+    ap.add_argument("--gate-mse", type=float, default=1e-2,
+                    help="max gate=0.5T latent MSE vs the pinned ungated "
+                         "latents (ISSUE 1 drift contract)")
+    ap.add_argument("--skip-gate", action="store_true",
+                    help="skip the phase-gate drift check")
+    args = ap.parse_args(argv)
+
+    cases, golden_dir, pipe = _cases()
+    only = set(args.only.split(",")) if args.only else None
+    if only:
+        unknown = only - set(cases) - {"phase_gate"}
+        if unknown:
+            ap.error(f"unknown config(s) {sorted(unknown)}; "
+                     f"valid: {', '.join(cases)}, phase_gate")
+
+    drifted = []
+    for name, fn in cases.items():
+        if only and name not in only:
+            continue
+        path = os.path.join(golden_dir, f"{name}.npz")
+        if not os.path.exists(path):
+            print(f"{name:16s} MISSING golden array at {path}")
+            drifted.append(name)
+            continue
+        img = np.asarray(fn(pipe)).astype(np.int16)
+        ref = np.load(path)["image"].astype(np.int16)
+        if img.shape != ref.shape:
+            print(f"{name:16s} SHAPE {img.shape} vs golden {ref.shape}")
+            drifted.append(name)
+            continue
+        d = np.abs(img - ref)
+        mse = float((d.astype(np.float64) ** 2).mean())
+        ok = mse <= args.mse and d.max() <= args.max_abs
+        print(f"{name:16s} mse={mse:.4g} max|Δ|={int(d.max())} "
+              f"{'ok' if ok else 'DRIFT'}")
+        if not ok:
+            drifted.append(name)
+
+    if not args.skip_gate and (only is None or "phase_gate" in only):
+        mse, mx = _phase_gate_drift()
+        ok = mse <= args.gate_mse
+        print(f"{'phase_gate':16s} latent mse={mse:.4g} max|Δ|={mx:.3g} "
+              f"{'ok' if ok else 'DRIFT'}")
+        if not ok:
+            drifted.append("phase_gate")
+
+    if drifted:
+        print(f"QUALITY GATE FAILED: {', '.join(drifted)} "
+              "(regenerate goldens only for intentional numerics changes: "
+              "P2P_REGEN_GOLDEN=1 pytest tests/test_golden.py)")
+        return 1
+    print("quality gate passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
