@@ -148,7 +148,7 @@ _TIMEOUT = object()  # sentinel: the inner subprocess hit its timeout
 # past the gate step — an *algorithmic* win, reported with per-phase ms/step
 # so the trajectory can tell it apart from kernel wins).
 _BLOCK_KEYS = ("gsweep", "gate", "dpm", "dpm_batched", "reweight",
-               "refine_blend", "ldm256", "nullinv")
+               "refine_blend", "ldm256", "serve", "nullinv")
 
 
 def _secondaries_filter(preset, env_value):
@@ -831,6 +831,56 @@ def _measure(preset):
                 g, lctrls, s, bpipe=lpipe)) * g * len(prompts)
             extras["ldm256_8prompt_imgs_per_s"] = round(rate, 4)
 
+        # Request-level serving rehearsal (ISSUE 2): replay a deterministic
+        # loadgen Poisson trace through the serve loop (queue → dynamic
+        # batcher → program cache → sweep) and record the serving schema —
+        # p50/p95 request latency, mean batch occupancy, program-cache hit
+        # rate — so future rounds track serving regressions alongside raw
+        # throughput. Compile-ahead (prewarm) keeps the one program build
+        # off the request path, exactly as the serve CLI defaults to; the
+        # trace is sized so the batcher runs at steady occupancy (arrivals
+        # far denser than a batch's service time).
+        def serve_rehearsal():
+            import importlib.util
+
+            from p2p_tpu.serve import Request, serve_forever
+
+            spec = importlib.util.spec_from_file_location(
+                "loadgen", os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "tools", "loadgen.py"))
+            loadgen = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(loadgen)
+
+            n = 16 if full else 24
+            trace_dicts = loadgen.generate_trace(
+                n, mode="poisson", rate_per_s=50.0, seed=0,
+                steps=num_steps)
+            reqs = [Request.from_dict(d) for d in trace_dicts]
+            summary = None
+            n_ok = 0
+            for rec in serve_forever(pipe, reqs, max_batch=4,
+                                     max_wait_ms=100.0,
+                                     prewarm=reqs[:1]):
+                if rec["status"] == "ok":
+                    n_ok += 1
+                elif rec["status"] == "summary":
+                    summary = rec
+            if n_ok != n:
+                raise RuntimeError(
+                    f"serve rehearsal served {n_ok}/{n} requests "
+                    f"(counts: {summary and summary['counts']})")
+            extras["serve"] = {
+                "n_requests": n,
+                "n_batches": summary["n_batches"],
+                "p50_ms": round(summary["p50_ms"], 2),
+                "p95_ms": round(summary["p95_ms"], 2),
+                "mean_batch_occupancy": round(
+                    summary["mean_batch_occupancy"], 3),
+                "program_cache_hit_rate": round(
+                    summary["dispatch_hit_rate"], 4),
+                "prewarm_ms": round(summary["prewarm_ms"], 1),
+            }
+
         # Null-text inversion wallclock (BASELINE.json config 4 and part of
         # its metric line; `/root/reference/null_text.py:608-618` workload:
         # 50 DDIM inversion steps + per-step uncond optimization, ≤10 inner
@@ -865,6 +915,8 @@ def _measure(preset):
                   needs_sweep=True)
         secondary("refine_blend", "refine+blend secondary", refine_localblend)
         secondary("ldm256", "ldm256 secondary", ldm256_batch, needs_sweep=True)
+        secondary("serve", "serve rehearsal secondary", serve_rehearsal,
+                  needs_sweep=True)
         # min_left=420: the warm-cache need is two sampling-scale passes
         # (~2-3 min); 900 made the metric unreachable inside realistic
         # ~26-min windows (VERDICT r3 weak #4). A cold-cache full run may

@@ -82,23 +82,39 @@ def _parse_equalizer(spec: Optional[str]):
     return {"words": tuple(words), "values": tuple(values)}
 
 
-def _make_controller(args, prompts, tokenizer, num_steps):
+def controller_from_opts(prompts, tokenizer, num_steps, *, mode,
+                         cross_steps, self_steps, blend_words=None,
+                         equalizer=None, blend_resolution=16):
+    """The one controller assembly both request surfaces share: the CLI
+    subcommands (via ``_make_controller``) and the serving layer
+    (``serve.request.prepare``) build edit controllers through this exact
+    call, so a spec accepted by one surface is accepted — and means the
+    same program — on the other. ``blend_words``/``equalizer`` use the CLI
+    string syntax ("cat,dog" / "word=scale,...")."""
     from .controllers.factory import make_controller
 
-    blend = args.blend_words.split(",") if args.blend_words else None
+    blend = blend_words.split(",") if blend_words else None
     if blend is not None:
         blend = [blend] * len(prompts)
     return make_controller(
         prompts,
-        is_replace_controller=args.mode == "replace",
-        cross_replace_steps=args.cross_steps,
-        self_replace_steps=args.self_steps,
+        is_replace_controller=mode == "replace",
+        cross_replace_steps=cross_steps,
+        self_replace_steps=self_steps,
         tokenizer=tokenizer,
         num_steps=num_steps,
         blend_words=blend,
-        equalizer_params=_parse_equalizer(args.equalizer),
-        blend_resolution=args.blend_resolution,
+        equalizer_params=_parse_equalizer(equalizer),
+        blend_resolution=blend_resolution,
     )
+
+
+def _make_controller(args, prompts, tokenizer, num_steps):
+    return controller_from_opts(
+        prompts, tokenizer, num_steps, mode=args.mode,
+        cross_steps=args.cross_steps, self_steps=args.self_steps,
+        blend_words=args.blend_words, equalizer=args.equalizer,
+        blend_resolution=args.blend_resolution)
 
 
 def cmd_generate(args) -> int:
@@ -398,6 +414,68 @@ def _replay_batched(args, pipe, art, targets, out_dir, edited_path) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Request-level serving: drain a JSONL request trace through the
+    serve subsystem (queue → dynamic batcher → program cache → worker
+    loop), writing one JSONL record per request plus a summary. See
+    docs/SERVING.md for the request schema."""
+    import json
+
+    from .serve import Request, parse_jsonl_line, serve_forever
+    from .utils.progress import trace as prof_trace
+
+    pipe = _build_pipeline(args)
+    stream = sys.stdin if args.requests == "-" else open(args.requests)
+    items = []
+    with stream:
+        for i, line in enumerate(stream):
+            try:
+                item = parse_jsonl_line(line)
+            except (ValueError, KeyError) as e:
+                raise SystemExit(f"--requests line {i + 1}: {e}")
+            if item is not None:
+                items.append(item)
+    prewarm = None
+    if not args.no_prewarm:
+        # Compile-ahead with the first request as the representative shape:
+        # uniform traffic then never pays a compile in-band.
+        prewarm = [r for r in items if isinstance(r, Request)][:1]
+
+    out = open(args.results, "w") if args.results else sys.stdout
+
+    def emit(rec):
+        rec = dict(rec)
+        images = rec.pop("images", None)
+        if images is not None and args.out_dir:
+            names = ([f"{rec['request_id']}.png"] if len(images) == 1 else
+                     [f"{rec['request_id']}_y.png",
+                      f"{rec['request_id']}_y_hat.png"])
+            rec["image_paths"] = [os.path.join(args.out_dir, n)
+                                  for n in names]
+            from PIL import Image
+
+            os.makedirs(args.out_dir, exist_ok=True)
+            for img, path in zip(images, rec["image_paths"]):
+                # Not _save: its "wrote ..." print would interleave with
+                # JSONL records when results go to stdout.
+                Image.fromarray(np.asarray(img)).save(path)
+        out.write(json.dumps(rec) + "\n")
+        out.flush()
+
+    try:
+        with prof_trace(args.profile):
+            for rec in serve_forever(
+                    pipe, items, max_batch=args.max_batch,
+                    max_wait_ms=args.max_wait_ms, queue_cap=args.queue_cap,
+                    program_cache_cap=args.program_cache_cap,
+                    prewarm=prewarm, progress=not args.quiet):
+                emit(rec)
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    return 0
+
+
 def cmd_check(args) -> int:
     from .models.checkpoint_check import _print_report, check_checkpoint
 
@@ -432,7 +510,7 @@ def build_parser() -> argparse.ArgumentParser:
     # Each subcommand declares exactly the flags it honors — no
     # accepted-but-ignored options (the reference's unread `--path
     # config.yaml`, `/root/reference/main.py:388`, is the anti-pattern).
-    def model_opts(sp):
+    def model_opts(sp, guidance=True):
         # Literal name tuples: build_parser must stay jax-free so --help and
         # argparse errors are instant. Drift against the canonical
         # PRESET_CONFIGS map is pinned by
@@ -447,7 +525,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "here")
         sp.add_argument("--checkpoint", default=None,
                         help="diffusers-format checkpoint dir (unet/ vae/ ...)")
-        sp.add_argument("--guidance", type=float, default=7.5)
+        if guidance:
+            # serve omits this: guidance is a per-request JSONL field there
+            # (honored-flags discipline — no accepted-but-ignored options).
+            sp.add_argument("--guidance", type=float, default=7.5)
         sp.add_argument("--quiet", action="store_true",
                         help="suppress per-step progress output")
         sp.add_argument("--profile", default=None, metavar="DIR",
@@ -543,6 +624,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "(one edit group per target, sharded over the mesh; "
                         "all targets share --mode/--blend-words/--equalizer)")
     r.set_defaults(fn=cmd_replay)
+
+    s = sub.add_parser(
+        "serve",
+        help="request-level serving: JSONL requests in, JSONL records out")
+    model_opts(s, guidance=False)
+    s.add_argument("--requests", required=True,
+                   help="JSONL request trace: a file, a FIFO, or '-' for "
+                        "stdin (schema: docs/SERVING.md; generator: "
+                        "tools/loadgen.py)")
+    s.add_argument("--results", default=None, metavar="FILE",
+                   help="write per-request result records here "
+                        "(default: stdout)")
+    s.add_argument("--out-dir", default=None, metavar="DIR",
+                   help="also write served images here "
+                        "(<id>.png, or <id>_y.png/<id>_y_hat.png for edits)")
+    s.add_argument("--max-batch", type=int, default=8, choices=(1, 2, 4, 8),
+                   help="flush a compile-key bucket at this many requests "
+                        "(must be one of the fixed padding buckets)")
+    s.add_argument("--max-wait-ms", type=float, default=50.0,
+                   help="flush a partial bucket after its oldest request "
+                        "has waited this long")
+    s.add_argument("--queue-cap", type=int, default=64,
+                   help="admission bound on outstanding requests; beyond "
+                        "it, requests are rejected with a reason "
+                        "(backpressure, never a silent drop)")
+    s.add_argument("--program-cache-cap", type=int, default=8,
+                   help="LRU capacity of the compiled-program cache")
+    s.add_argument("--no-prewarm", action="store_true",
+                   help="skip compile-ahead of the first request's program "
+                        "(compiles then happen in-band on first dispatch)")
+    s.set_defaults(fn=cmd_serve)
 
     c = sub.add_parser(
         "check", help="checkpoint-readiness report (no weights loaded)")

@@ -95,6 +95,22 @@ def init_latent(latent: Optional[jax.Array], shape: Tuple[int, ...], rng: jax.Ar
     return latent, latents
 
 
+def lane_select(outputs, lanes):
+    """Batch-lane masking hook for the serving layer.
+
+    A padded serve batch runs ``sweep`` with ``G = bucket`` lanes of which
+    only the first ``len(lanes)`` carry real requests (padding replicates a
+    real lane; a poisoned lane is dropped on the isolation retry). This is
+    the single place lane → request resolution happens: it gathers the
+    selected lanes of a ``(G, ...)`` output to host numpy, so padded or
+    masked-out lanes can never leak into a response record.
+    """
+    import numpy as np
+
+    out = np.asarray(outputs)
+    return [out[i] for i in lanes]
+
+
 def resolve_gate(gate, num_scan_steps: int,
                  controller: Optional[Controller] = None) -> int:
     """Resolve a user-facing ``gate`` spec to a static scan-step index.

@@ -1,0 +1,21 @@
+"""orbax version compatibility shims for the checkpoint layer.
+
+``PyTreeCheckpointer.metadata()`` drifted across orbax releases: newer
+builds return a ``CheckpointMetadata`` wrapper (the tree hangs off
+``.item_metadata.tree``), the 0.x line the container ships returns the
+metadata tree itself (a plain dict/pytree). ``models/native.py`` targets
+the modern surface; this shim keeps the native-snapshot restore path (and
+its tier-1 tests) alive on both — same role as ``parallel/compat.py`` for
+``shard_map``.
+"""
+
+from __future__ import annotations
+
+
+def metadata_tree(checkpointer, path: str):
+    """The restored tree's metadata pytree, on every orbax metadata()
+    return shape: a ``CheckpointMetadata`` wrapper, a bare
+    ``item_metadata`` holder, or the tree itself."""
+    meta = checkpointer.metadata(path)
+    item = getattr(meta, "item_metadata", meta)
+    return getattr(item, "tree", item)

@@ -1,0 +1,30 @@
+"""Request-level serving: queue → dynamic batcher → program cache → worker.
+
+The one-shot entry points (``engine.sampler.text2image``, ``parallel.sweep``)
+serve a single caller; this package serves *traffic*: JSONL requests ride a
+bounded admission queue, compatible requests batch by compile key (padded to
+a fixed bucket set so the program count stays bounded), compiled programs
+are cached and compiled ahead of traffic, and a single-threaded worker loop
+drains batches while emitting one structured record per request. See
+docs/SERVING.md.
+"""
+
+from .batcher import BUCKET_SIZES, DynamicBatcher, bucket_for
+from .engine_loop import serve_forever
+from .programs import ProgramCache
+from .queue import AdmissionQueue, Rejected
+from .request import Cancel, Request, parse_jsonl_line, prepare
+
+__all__ = [
+    "AdmissionQueue",
+    "BUCKET_SIZES",
+    "Cancel",
+    "DynamicBatcher",
+    "ProgramCache",
+    "Rejected",
+    "Request",
+    "bucket_for",
+    "parse_jsonl_line",
+    "prepare",
+    "serve_forever",
+]

@@ -1,0 +1,120 @@
+"""Dynamic batcher: bucket compatible requests, pad to fixed batch sizes.
+
+Requests group by ``batch_key`` (compile key + traced-but-shared values —
+see ``serve.request``), so a bucket never mixes work that couldn't ride one
+``parallel.sweep`` call. A bucket flushes when it reaches ``max_batch`` or
+when its oldest entry has waited ``max_wait_ms`` — the classic latency ⇄
+occupancy trade, both knobs surfaced on the CLI.
+
+Dispatched batches are padded up to a small fixed set of lane counts
+(:data:`BUCKET_SIZES`, capped by ``max_batch``) so the number of distinct
+XLA programs stays bounded no matter what sizes the traffic produces; the
+padding lanes replicate a real request and are masked out of results by the
+engine (``engine.sampler.lane_select``). The engine may also pad a partial
+batch *up* to a larger already-compiled bucket (warm-preference) — trading
+a few wasted lanes for keeping compiles off the request path entirely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from .queue import Entry
+
+BUCKET_SIZES = (1, 2, 4, 8)
+
+
+def bucket_for(n: int, max_batch: int = BUCKET_SIZES[-1]) -> int:
+    """Smallest fixed bucket holding ``n`` lanes (≤ ``max_batch``).
+
+    ``max_batch`` must itself be one of :data:`BUCKET_SIZES`: a cap between
+    buckets (say 5) would force a 5-entry flush into a 4-lane bucket,
+    silently breaking the every-entry-gets-a-lane padding contract and the
+    bounded-program-count guarantee built on it.
+    """
+    if n < 1:
+        raise ValueError(f"bucket_for needs n >= 1, got {n}")
+    if max_batch not in BUCKET_SIZES:
+        raise ValueError(f"max_batch must be one of {BUCKET_SIZES}, "
+                         f"got {max_batch}")
+    for b in BUCKET_SIZES:
+        if b >= min(n, max_batch):
+            return b
+    return BUCKET_SIZES[-1]
+
+
+@dataclasses.dataclass
+class Batch:
+    """A flush unit: compatible entries + the bucket they pad to."""
+
+    batch_key: Tuple
+    entries: List[Entry]
+    created_ms: float
+
+    @property
+    def compile_key(self) -> Tuple:
+        return self.entries[0].prepared.compile_key
+
+
+class DynamicBatcher:
+    """Groups entries by ``batch_key``; flushes on max-batch or max-wait."""
+
+    def __init__(self, max_batch: int = 8, max_wait_ms: float = 50.0):
+        if max_batch not in BUCKET_SIZES:
+            raise ValueError(
+                f"max_batch must be one of {BUCKET_SIZES}, got {max_batch}")
+        self.max_batch = max_batch
+        self.max_wait_ms = float(max_wait_ms)
+        self._waiting: Dict[Tuple, List[Entry]] = {}
+        self._oldest_ms: Dict[Tuple, float] = {}
+
+    def __len__(self) -> int:
+        return sum(len(v) for v in self._waiting.values())
+
+    def add(self, entry: Entry, now_ms: float) -> None:
+        key = entry.prepared.batch_key
+        group = self._waiting.setdefault(key, [])
+        if not group:
+            self._oldest_ms[key] = now_ms
+        group.append(entry)
+
+    def next_flush_ms(self) -> Optional[float]:
+        """Earliest future time a waiting bucket ages out (None when empty).
+        Full buckets flush immediately via ``ready``, so only age matters."""
+        if not self._oldest_ms:
+            return None
+        return min(self._oldest_ms.values()) + self.max_wait_ms
+
+    def _pop(self, key: Tuple, n: int, now_ms: float) -> Batch:
+        group = self._waiting[key]
+        taken, rest = group[:n], group[n:]
+        if rest:
+            self._waiting[key] = rest
+            self._oldest_ms[key] = now_ms  # age restarts for the remainder
+        else:
+            del self._waiting[key]
+            del self._oldest_ms[key]
+        return Batch(batch_key=key, entries=taken, created_ms=now_ms)
+
+    def ready(self, now_ms: float) -> List[Batch]:
+        """Flush every bucket that is full or has aged past max-wait."""
+        out: List[Batch] = []
+        for key in list(self._waiting):
+            while key in self._waiting and \
+                    len(self._waiting[key]) >= self.max_batch:
+                out.append(self._pop(key, self.max_batch, now_ms))
+            if key in self._waiting and \
+                    now_ms - self._oldest_ms[key] >= self.max_wait_ms:
+                out.append(self._pop(key, self.max_batch, now_ms))
+        out.sort(key=lambda b: min(e.seq for e in b.entries))
+        return out
+
+    def flush_all(self, now_ms: float) -> List[Batch]:
+        """Drain everything (end of trace / shutdown)."""
+        out: List[Batch] = []
+        for key in list(self._waiting):
+            while key in self._waiting:
+                out.append(self._pop(key, self.max_batch, now_ms))
+        out.sort(key=lambda b: min(e.seq for e in b.entries))
+        return out

@@ -1,0 +1,338 @@
+"""Single-threaded serve worker: admit → batch → dispatch → record.
+
+The loop runs on a *virtual clock*: trace time (request ``arrival_ms``,
+batcher age-out, deadlines) advances either to the next event (an arrival
+or a bucket aging out) or by the measured wall time of each dispatched
+batch. That makes the control flow — admission order, bucketing, deadline
+expiry, backpressure — fully deterministic for a given trace and runner,
+while latency numbers stay real measurements. A JSONL file replay, the
+bench ``serve`` rehearsal, and the tests all ride the same loop.
+
+Every submitted request resolves to exactly ONE structured record:
+
+- ``ok`` — served; carries ``images`` (B, H, W, 3) uint8 plus the latency
+  split: ``queue_wait_ms`` (arrival → dispatch), ``compile_ms`` (its
+  batch's program build/warm cost, 0 on a program-cache hit), ``run_ms``
+  (batch execution), ``total_ms``; plus ``batch_lanes`` (padded bucket),
+  ``batch_occupancy`` (real lanes), ``cache_hit``.
+- ``rejected`` — failed validation or backpressure; ``reason`` says why.
+- ``expired`` — deadline passed before dispatch (never runs).
+- ``cancelled`` — a ``{"cancel": id}`` record landed before dispatch.
+- ``error`` — the request itself poisoned a program: its batch failed, the
+  survivors were re-run without it (isolation retry), and only this lane
+  failed again. One bad request can never take its batchmates down.
+
+A final ``summary`` record aggregates the run: counts per status, batch
+count, mean occupancy, program-cache stats, latency percentiles.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, Iterator, List, Optional
+
+from . import queue as queue_mod
+from .batcher import BUCKET_SIZES, Batch, DynamicBatcher, bucket_for
+from .programs import ProgramCache, default_runner_factory
+from .queue import AdmissionQueue, Rejected
+from .request import Cancel, PreparedRequest, Request, prepare
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list (0 when empty) —
+    tiny and dependency-free; good enough for latency reporting."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(
+        q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class _Trace:
+    """Pull-parser over the request stream; enforces sorted arrivals."""
+
+    def __init__(self, items: Iterable):
+        self._it = iter(items)
+        self._next = None
+        self._last_arrival = float("-inf")
+        self._advance()
+
+    def _advance(self) -> None:
+        try:
+            item = next(self._it)
+        except StopIteration:
+            self._next = None
+            return
+        if isinstance(item, dict):
+            item = (Cancel(str(item["cancel"])) if set(item) == {"cancel"}
+                    else Request.from_dict(item))
+        if isinstance(item, Request):
+            if item.arrival_ms < self._last_arrival:
+                raise ValueError(
+                    f"request {item.request_id!r} arrives at "
+                    f"{item.arrival_ms}ms, after a {self._last_arrival}ms "
+                    "arrival — the trace must be sorted by arrival_ms")
+            self._last_arrival = item.arrival_ms
+        self._next = item
+
+    def peek(self):
+        return self._next
+
+    def pop(self):
+        item = self._next
+        self._advance()
+        return item
+
+    @property
+    def next_arrival_ms(self) -> Optional[float]:
+        if self._next is None:
+            return None
+        return getattr(self._next, "arrival_ms", self._last_arrival)
+
+
+def _pick_bucket(n: int, compile_key, max_batch: int,
+                 cache: ProgramCache) -> int:
+    """Smallest bucket that fits — unless a larger bucket for the same
+    compile key is already warm, in which case pad up to it: a few wasted
+    lanes beat compiling (and caching) one more program."""
+    smallest = bucket_for(n, max_batch)
+    for b in BUCKET_SIZES:
+        if b >= smallest and b <= max_batch and (compile_key, b) in cache:
+            return b
+    return smallest
+
+
+def serve_forever(
+    pipe,
+    requests: Iterable,
+    *,
+    max_batch: int = 8,
+    max_wait_ms: float = 50.0,
+    queue_cap: int = 64,
+    program_cache_cap: int = 8,
+    prewarm: Optional[Iterable[Request]] = None,
+    progress: bool = False,
+    runner_factory: Optional[Callable] = None,
+    timer: Callable[[], float] = time.perf_counter,
+) -> Iterator[dict]:
+    """Drain ``requests`` (Request/Cancel objects or JSONL-shaped dicts,
+    sorted by ``arrival_ms``) through the queue → batcher → program-cache →
+    sweep pipeline; yield one record per request plus a final summary.
+
+    ``prewarm``: representative requests whose ``(compile_key, max-bucket)``
+    programs are built before the trace starts — compile-ahead, so steady
+    traffic never pays a compile in-band. ``runner_factory(compile_key,
+    bucket) -> runner`` and ``timer`` are injection points for tests and
+    rehearsal; the defaults run real ``parallel.sweep`` batches and measure
+    wall time.
+    """
+    from ..engine.sampler import lane_select
+    from ..utils import progress as progress_mod
+
+    make_runner = runner_factory or default_runner_factory(pipe,
+                                                           progress=progress)
+    queue = AdmissionQueue(queue_cap)
+    batcher = DynamicBatcher(max_batch=max_batch, max_wait_ms=max_wait_ms)
+    cache = ProgramCache(program_cache_cap)
+    trace = _Trace(requests)
+
+    counts = {"ok": 0, "rejected": 0, "expired": 0, "cancelled": 0,
+              "error": 0}
+    latencies: List[float] = []
+    occupancies: List[int] = []
+    batch_hits: List[bool] = []
+    prewarm_ms = 0.0
+    vnow = 0.0
+    batch_index = 0
+
+    def record(status: str, request_id: str, *, release: bool = True,
+               **fields) -> dict:
+        # release=False for admission rejections: a rejected submission was
+        # never admitted, and its id may belong to a still-live earlier
+        # request (duplicate-id rejection) whose capacity slot and cancel
+        # marker must survive.
+        counts[status] += 1
+        if release:
+            queue.release(request_id)
+        return {"request_id": request_id, "status": status, **fields}
+
+    def _build(factory, compile_key, bucket, entries):
+        runner = factory(compile_key, bucket)
+        warm = getattr(runner, "warm", None)
+        if warm is not None:
+            warm(entries)
+        return runner
+
+    if prewarm:
+        t0 = timer()
+        for req in prewarm:
+            try:
+                prep = prepare(req, pipe)
+            except ValueError:
+                # Prewarm is an optimization: an invalid spec here must not
+                # take the server down — the same request gets its proper
+                # 'rejected' record if/when it arrives in the trace.
+                continue
+            bucket = bucket_for(max_batch, max_batch)
+            entry = queue_mod.Entry(prepared=prep, arrival_ms=0.0)
+            cache.get((prep.compile_key, bucket),
+                      lambda p=prep, b=bucket, e=entry: _build(
+                          make_runner, p.compile_key, b, [e]))
+        prewarm_ms = (timer() - t0) * 1000.0
+
+    def run_entries(entries, compile_key, guidance, bucket):
+        """Run one padded batch; returns (images, compile_ms, run_ms, hit).
+        The steps the compiled loop reports flow into per-request progress
+        via the shared step hook."""
+        runner, hit, _ = cache.get(
+            (compile_key, bucket),
+            lambda: _build(make_runner, compile_key, bucket, entries))
+        # cache.get's build_ms times only the closure; re-derive compile_ms
+        # from our own timer so injected timers see it too.
+        t0 = timer()
+        steps_seen = []
+        if progress:
+            progress_mod.set_step_hook(lambda s: steps_seen.append(int(s)))
+        try:
+            imgs = runner(entries, guidance)
+        finally:
+            if progress:
+                progress_mod.set_step_hook(None)
+        run_ms = (timer() - t0) * 1000.0
+        return imgs, run_ms, hit, (max(steps_seen) + 1 if steps_seen else None)
+
+    def dispatch(batch: Batch) -> Iterator[dict]:
+        nonlocal vnow, batch_index
+        live = []
+        for e in batch.entries:
+            if queue.is_cancelled(e.request_id):
+                yield record("cancelled", e.request_id,
+                             arrival_ms=e.arrival_ms,
+                             queue_wait_ms=vnow - e.arrival_ms)
+            elif queue_mod.expired(e, vnow):
+                yield record(
+                    "expired", e.request_id, arrival_ms=e.arrival_ms,
+                    reason=(f"deadline {e.request.deadline_ms}ms passed "
+                            f"before dispatch (waited "
+                            f"{vnow - e.arrival_ms:.1f}ms)"))
+            else:
+                live.append(e)
+        if not live:
+            return
+        batch_index += 1
+        this_batch = batch_index
+        guidance = live[0].request.guidance
+        compile_key = live[0].prepared.compile_key
+        bucket = _pick_bucket(len(live), compile_key, max_batch, cache)
+        dispatch_ms = vnow
+        try:
+            t0 = timer()
+            imgs, run_ms, hit, steps_done = run_entries(
+                live, compile_key, guidance, bucket)
+            total_ms = (timer() - t0) * 1000.0
+            compile_ms = max(0.0, total_ms - run_ms)
+        except Exception as exc:  # noqa: BLE001 — isolate, then re-raise per lane
+            vnow += (timer() - t0) * 1000.0
+            yield from isolate(live, compile_key, guidance, exc)
+            return
+        vnow += compile_ms + run_ms
+        occupancies.append(len(live))
+        batch_hits.append(hit)
+        lanes = lane_select(imgs, range(len(live)))
+        for i, e in enumerate(live):
+            latency = vnow - e.arrival_ms
+            latencies.append(latency)
+            yield record(
+                "ok", e.request_id, images=lanes[i],
+                arrival_ms=e.arrival_ms,
+                queue_wait_ms=dispatch_ms - e.arrival_ms,
+                compile_ms=compile_ms, run_ms=run_ms, total_ms=latency,
+                batch_id=this_batch, batch_lanes=bucket,
+                batch_occupancy=len(live), cache_hit=hit,
+                gate_step=e.prepared.gate_step,
+                **({"steps_done": steps_done} if steps_done else {}))
+
+    def isolate(entries, compile_key, guidance, batch_exc) -> Iterator[dict]:
+        """A batch failed: re-run each lane alone so one poisoned request
+        fails alone; survivors still get served (one retry each)."""
+        nonlocal vnow, batch_index
+        for e in entries:
+            batch_index += 1
+            bucket = _pick_bucket(1, compile_key, max_batch, cache)
+            dispatch_ms = vnow
+            try:
+                t0 = timer()
+                imgs, run_ms, hit, steps_done = run_entries(
+                    [e], compile_key, guidance, bucket)
+                compile_ms = max(0.0, (timer() - t0) * 1000.0 - run_ms)
+            except Exception as exc:  # noqa: BLE001
+                vnow += (timer() - t0) * 1000.0
+                yield record(
+                    "error", e.request_id, arrival_ms=e.arrival_ms,
+                    reason=f"{type(exc).__name__}: {exc}",
+                    batch_error=f"{type(batch_exc).__name__}: {batch_exc}")
+                continue
+            vnow += compile_ms + run_ms
+            occupancies.append(1)
+            batch_hits.append(hit)
+            lanes = lane_select(imgs, range(1))
+            latency = vnow - e.arrival_ms
+            latencies.append(latency)
+            yield record(
+                "ok", e.request_id, images=lanes[0],
+                arrival_ms=e.arrival_ms,
+                queue_wait_ms=dispatch_ms - e.arrival_ms,
+                compile_ms=compile_ms, run_ms=run_ms, total_ms=latency,
+                batch_id=batch_index, batch_lanes=bucket, batch_occupancy=1,
+                cache_hit=hit, isolated_retry=True,
+                gate_step=e.prepared.gate_step,
+                **({"steps_done": steps_done} if steps_done else {}))
+
+    while True:
+        # 1. Admit everything that has arrived by now.
+        while trace.peek() is not None and \
+                getattr(trace.peek(), "arrival_ms", vnow) <= vnow:
+            item = trace.pop()
+            if isinstance(item, Cancel):
+                queue.cancel(item.request_id)  # unknown id: benign no-op
+                continue
+            try:
+                prep = prepare(item, pipe)
+                queue.submit(prep, vnow)
+            except (Rejected, ValueError) as e:
+                reason = e.reason if isinstance(e, Rejected) else str(e)
+                yield record("rejected", item.request_id, release=False,
+                             arrival_ms=item.arrival_ms, reason=reason)
+        # 2. Feed the batcher.
+        for entry in queue.drain():
+            batcher.add(entry, vnow)
+        # 3. Flush whatever is due.
+        batches = batcher.ready(vnow)
+        if not batches:
+            events = [t for t in (trace.next_arrival_ms,
+                                  batcher.next_flush_ms()) if t is not None]
+            if events:
+                vnow = max(vnow, min(events))
+                continue
+            batches = batcher.flush_all(vnow)  # trace done: drain the tail
+            if not batches:
+                break
+        for batch in batches:
+            yield from dispatch(batch)
+
+    n_batches = len(occupancies)
+    lat_sorted = sorted(latencies)
+    yield {
+        "request_id": None, "status": "summary",
+        "counts": dict(counts),
+        "n_batches": n_batches,
+        "mean_batch_occupancy": (sum(occupancies) / n_batches
+                                 if n_batches else 0.0),
+        "dispatch_hit_rate": (sum(batch_hits) / len(batch_hits)
+                              if batch_hits else 0.0),
+        "program_cache": cache.stats(),
+        "prewarm_ms": prewarm_ms,
+        "p50_ms": _percentile(lat_sorted, 50),
+        "p95_ms": _percentile(lat_sorted, 95),
+        "makespan_ms": vnow,
+    }

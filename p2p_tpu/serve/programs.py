@@ -1,0 +1,151 @@
+"""LRU compiled-program cache for the serve loop.
+
+A *program* here is a host-side runner bound to one ``(compile_key,
+bucket)`` pair: a closure over the pipeline and every static sweep argument
+(steps, scheduler, gate step, lane count). Building one warms it on
+zero-valued inputs of the real batch's shapes — the XLA trace+compile (and
+one cheap throwaway execution) happen at build time, so by the time real
+lanes run the program, request latency is steady-state. The warm cost is
+what the per-request ``compile_ms`` field reports.
+
+The LRU evicts host handles only; the actual XLA executables additionally
+live in the repo-wide persistent compile cache
+(``utils.cache.default_cache_dir()``, enabled once per process via
+``utils.cache.ensure_persistent_cache``), so re-building an evicted program
+— or the same program in the next server process — is mostly disk I/O, not
+a recompile. Counters (hits / misses / evictions) feed the per-request
+records and the bench ``serve`` block.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Callable, Optional, Tuple
+
+from ..utils.cache import ensure_persistent_cache
+
+
+class ProgramCache:
+    """LRU over built runners, keyed by ``(compile_key, bucket)``."""
+
+    def __init__(self, capacity: int = 8):
+        if capacity < 1:
+            raise ValueError(f"program cache capacity must be >= 1, "
+                             f"got {capacity}")
+        ensure_persistent_cache()
+        self.capacity = capacity
+        self._lru: "OrderedDict[Tuple, object]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def __contains__(self, key: Tuple) -> bool:
+        return key in self._lru
+
+    def get(self, key: Tuple, build: Callable[[], object]):
+        """Return ``(runner, hit, build_ms)``; builds (and warms) on miss."""
+        if key in self._lru:
+            self._lru.move_to_end(key)
+            self.hits += 1
+            return self._lru[key], True, 0.0
+        self.misses += 1
+        t0 = time.perf_counter()
+        runner = build()
+        build_ms = (time.perf_counter() - t0) * 1000.0
+        self._lru[key] = runner
+        while len(self._lru) > self.capacity:
+            self._lru.popitem(last=False)
+            self.evictions += 1
+        return runner, False, build_ms
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {"hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions, "size": len(self._lru),
+                "hit_rate": (self.hits / total) if total else 0.0}
+
+
+class SweepRunner:
+    """Default runner: encode + stack + pad one batch, run ``parallel.sweep``.
+
+    Encoding uses exactly the calls (and call shapes) ``text2image`` uses
+    per request — cond and uncond encoded per request at the request's own
+    prompt-batch size, latents drawn as ``normal(PRNGKey(seed))`` — so a
+    lane's output is bitwise-identical to the direct path's for the same
+    request (the quality-gate ``serve_parity`` contract).
+    """
+
+    def __init__(self, pipe, compile_key: Tuple, bucket: int,
+                 progress: bool = False):
+        self.pipe = pipe
+        (_, self.steps, self.scheduler, self.gate_step, self.group_batch,
+         _) = compile_key
+        self.bucket = bucket
+        self.progress = progress
+
+    def _inputs(self, entries, zeros: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from ..engine.sampler import encode_prompts, init_latent
+
+        ctxs, lats, ctrls = [], [], []
+        for e in entries:
+            req = e.request
+            cond = encode_prompts(self.pipe, list(req.prompts))
+            uncond = encode_prompts(
+                self.pipe, [req.negative_prompt or ""] * len(req.prompts))
+            ctxs.append(jnp.concatenate([uncond, cond], axis=0))
+            _, lat_b = init_latent(None, self.pipe.latent_shape,
+                                   jax.random.PRNGKey(req.seed),
+                                   len(req.prompts))
+            lats.append(lat_b)
+            ctrls.append(e.prepared.controller)
+        while len(ctxs) < self.bucket:  # padding lanes replicate the last
+            ctxs.append(ctxs[-1])
+            lats.append(lats[-1])
+            ctrls.append(ctrls[-1])
+        ctx = jnp.stack(ctxs)
+        lat = jnp.stack(lats)
+        ctrl = (None if ctrls[0] is None else
+                jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ctrls))
+        if zeros:
+            ctx, lat = jnp.zeros_like(ctx), jnp.zeros_like(lat)
+        return ctx, lat, ctrl
+
+    def warm(self, entries) -> None:
+        """Compile-ahead: run once on zero inputs of the batch's shapes.
+        Shapes (not values) determine the program, so the real batch then
+        executes warm — compile stays off the request path."""
+        import numpy as np
+
+        ctx, lat, ctrl = self._inputs(entries, zeros=True)
+        np.asarray(self._run(ctx, lat, ctrl, guidance=1.0))
+
+    def _run(self, ctx, lat, ctrl, guidance: float):
+        from ..parallel import sweep
+
+        imgs, _ = sweep(self.pipe, ctx, lat, ctrl, num_steps=self.steps,
+                        guidance_scale=guidance, scheduler=self.scheduler,
+                        mesh=None, gate=self.gate_step,
+                        progress=self.progress)
+        return imgs
+
+    def __call__(self, entries, guidance: float):
+        import numpy as np
+
+        ctx, lat, ctrl = self._inputs(entries)
+        return np.asarray(self._run(ctx, lat, ctrl, guidance))
+
+
+def default_runner_factory(pipe, progress: bool = False):
+    """The engine's default ``runner_factory``: real sweeps on ``pipe``."""
+
+    def make(compile_key: Tuple, bucket: int) -> SweepRunner:
+        return SweepRunner(pipe, compile_key, bucket, progress=progress)
+
+    return make

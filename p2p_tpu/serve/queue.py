@@ -1,0 +1,129 @@
+"""Bounded admission queue: backpressure, deadlines, cancellation.
+
+Admission control for the serve loop. Capacity counts *outstanding* work —
+everything admitted and not yet resolved to a record (waiting here, waiting
+in the batcher, or in flight) — so a burst can't buffer unboundedly between
+the queue and the batcher. A full queue rejects with a reason
+(:class:`Rejected`), never a silent drop: every submitted request resolves
+to exactly one structured record downstream.
+
+Deadlines are *relative to arrival* and enforced before dispatch (the
+engine calls :func:`expired` when a batch is about to run); cancellation is
+a marker checked at the same point — both are only guaranteed for requests
+that have not yet dispatched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from .request import PreparedRequest
+
+
+class Rejected(Exception):
+    """Admission refused; ``reason`` says why (surfaced in the record)."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+@dataclasses.dataclass
+class Entry:
+    """One admitted request riding the queue → batcher → dispatch path."""
+
+    prepared: PreparedRequest
+    arrival_ms: float
+    seq: int = 0                 # admission order (stable sort tiebreak)
+    dispatch_ms: Optional[float] = None
+
+    @property
+    def request(self):
+        return self.prepared.request
+
+    @property
+    def request_id(self) -> str:
+        return self.prepared.request.request_id
+
+    @property
+    def deadline_at(self) -> Optional[float]:
+        d = self.prepared.request.deadline_ms
+        return None if d is None else self.arrival_ms + d
+
+
+def expired(entry: Entry, now_ms: float) -> bool:
+    """True when ``entry``'s deadline passed before dispatch."""
+    at = entry.deadline_at
+    return at is not None and now_ms > at
+
+
+class AdmissionQueue:
+    """Bounded waiting room in front of the batcher.
+
+    ``submit`` raises :class:`Rejected` when outstanding work is at
+    capacity; ``drain`` hands waiting entries to the batcher ordered by
+    (priority desc, arrival, admission order) while they stay *outstanding*
+    until the engine resolves them via ``release`` — that is what makes the
+    capacity a bound on the whole undispatched pipeline, not just this
+    deque."""
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"queue capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._waiting: List[Entry] = []
+        self._outstanding: Dict[str, Entry] = {}
+        self._cancelled: set = set()
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return len(self._waiting)
+
+    @property
+    def outstanding(self) -> int:
+        return len(self._outstanding)
+
+    def submit(self, prepared: PreparedRequest, now_ms: float) -> Entry:
+        rid = prepared.request.request_id
+        if rid in self._outstanding:
+            raise Rejected(f"duplicate request_id {rid!r} still in flight")
+        if len(self._outstanding) >= self.capacity:
+            raise Rejected(
+                f"queue full ({self.capacity} outstanding); retry later")
+        self._seq += 1
+        # Latency accounting starts at the request's TRACE arrival, not the
+        # (possibly later) moment the single-threaded loop got around to
+        # admitting it — time spent blocked behind a running batch is real
+        # queue wait the records must own up to.
+        entry = Entry(prepared=prepared,
+                      arrival_ms=max(0.0, prepared.request.arrival_ms),
+                      seq=self._seq)
+        self._waiting.append(entry)
+        self._outstanding[rid] = entry
+        return entry
+
+    def cancel(self, request_id: str) -> bool:
+        """Mark an outstanding request cancelled. Returns False for an
+        unknown/already-resolved id (the engine surfaces that as a no-op
+        record rather than an error — cancelling finished work is benign)."""
+        if request_id not in self._outstanding:
+            return False
+        self._cancelled.add(request_id)
+        return True
+
+    def is_cancelled(self, request_id: str) -> bool:
+        return request_id in self._cancelled
+
+    def drain(self) -> List[Entry]:
+        """Pop every waiting entry for the batcher, highest priority first
+        (FIFO within a priority level). Entries remain outstanding."""
+        out = sorted(self._waiting,
+                     key=lambda e: (-e.request.priority, e.arrival_ms, e.seq))
+        self._waiting = []
+        return out
+
+    def release(self, request_id: str) -> None:
+        """Resolve one admitted request (record emitted); frees capacity."""
+        self._outstanding.pop(request_id, None)
+        self._cancelled.discard(request_id)

@@ -1,0 +1,191 @@
+"""Request schema + admission-time validation for the serving layer.
+
+A :class:`Request` is the JSONL unit of work the serve loop consumes: one
+generation (``prompt``) or one prompt-to-prompt edit (``prompt`` +
+``target``), with the same knobs the CLI exposes per run (mode, windows,
+equalizer, seed, steps, scheduler, gate, negative prompt) plus the
+request-level fields the one-shot CLI has no use for: arrival time, a
+deadline, a priority, and a stable ``request_id``.
+
+Validation happens at admission, not dispatch: a request that can never run
+(bad mode/scheduler, a gate spec ``engine.sampler.resolve_gate`` rejects, a
+controller the factory can't build) is rejected with a reason before it
+costs queue capacity — the same controller factory and gate checks the CLI
+path uses (``cli.controller_from_opts`` / ``resolve_gate``), so the serve
+surface can never accept a spec the direct surface would refuse.
+
+:func:`prepare` also derives the two keys the batcher runs on:
+
+- ``compile_key`` — everything that changes the XLA program: steps,
+  scheduler kind, resolved gate step, group batch (1 or 2 prompts), and the
+  controller's *structure* (pytree treedef + leaf shapes/dtypes — edit
+  values are traced leaves and deliberately absent).
+- ``batch_key`` — ``compile_key`` plus the values that are traced but
+  *shared* across a sweep call (guidance scale): requests may share a
+  compiled program yet not a batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Optional, Tuple
+
+_SCHEDULERS = ("ddim", "plms", "dpm")
+_MODES = ("replace", "refine")
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One unit of serving work. ``target=None`` is pure generation; a
+    ``target`` makes it a 2-prompt edit group (source lane + edited lane,
+    the CLI ``edit`` semantics)."""
+
+    request_id: str
+    prompt: str
+    target: Optional[str] = None
+    mode: str = "refine"
+    cross_steps: float = 0.8
+    self_steps: float = 0.4
+    blend_words: Optional[str] = None
+    equalizer: Optional[str] = None
+    blend_resolution: int = 16
+    seed: int = 8191
+    steps: int = 50
+    scheduler: str = "ddim"
+    guidance: float = 7.5
+    negative_prompt: Optional[str] = None
+    gate: Any = None            # None | 'auto' | float fraction | int step
+    arrival_ms: float = 0.0     # virtual trace time (loadgen / replay)
+    deadline_ms: Optional[float] = None  # relative to arrival; None = none
+    priority: int = 0           # higher dispatches first
+
+    @property
+    def prompts(self) -> Tuple[str, ...]:
+        return (self.prompt,) if self.target is None else (self.prompt,
+                                                           self.target)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Request":
+        """Build a Request from a JSONL record, rejecting unknown keys (the
+        honored-flags discipline: a typo'd field must error, not silently
+        do nothing)."""
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - fields
+        if unknown:
+            raise ValueError(f"unknown request field(s) {sorted(unknown)}; "
+                             f"valid: {sorted(fields)}")
+        if "request_id" not in d or "prompt" not in d:
+            raise ValueError("request needs 'request_id' and 'prompt'")
+        return cls(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class Cancel:
+    """Control record: cancel a previously submitted request by id (only
+    guaranteed before its batch dispatches)."""
+
+    request_id: str
+
+
+def parse_jsonl_line(line: str):
+    """One serve-input line → :class:`Request` or :class:`Cancel` (a line of
+    the form ``{"cancel": "<id>"}``), or ``None`` for a blank line."""
+    line = line.strip()
+    if not line:
+        return None
+    d = json.loads(line)
+    if not isinstance(d, dict):
+        raise ValueError(f"request line must be a JSON object, got {d!r}")
+    if set(d) == {"cancel"}:
+        return Cancel(request_id=str(d["cancel"]))
+    return Request.from_dict(d)
+
+
+def _structural_validate(req: Request) -> None:
+    if not req.request_id:
+        raise ValueError("empty request_id")
+    if not req.prompt:
+        raise ValueError("empty prompt")
+    if req.steps < 1:
+        raise ValueError(f"steps must be >= 1, got {req.steps}")
+    if req.scheduler not in _SCHEDULERS:
+        raise ValueError(f"unknown scheduler {req.scheduler!r}; "
+                         f"valid: {', '.join(_SCHEDULERS)}")
+    if req.mode not in _MODES:
+        raise ValueError(f"unknown mode {req.mode!r}; valid: "
+                         f"{', '.join(_MODES)}")
+    if req.target is None and (req.blend_words or req.equalizer):
+        raise ValueError("blend_words/equalizer need a 'target' edit prompt")
+    if req.deadline_ms is not None and req.deadline_ms <= 0:
+        raise ValueError(f"deadline_ms must be positive, got {req.deadline_ms}")
+    if isinstance(req.gate, str) and req.gate != "auto":
+        raise ValueError(f"gate must be null, 'auto', a fraction or a step "
+                         f"index, got {req.gate!r}")
+
+
+def controller_signature(controller) -> Tuple:
+    """The controller's *static* program identity: pytree structure + leaf
+    shapes/dtypes. Edit values (equalizer scales, window schedules,
+    thresholds) are traced leaves and must NOT appear here — two requests
+    whose controllers differ only in values share one compiled program."""
+    if controller is None:
+        return ("none",)
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(controller)
+    return (str(treedef),
+            tuple((tuple(x.shape), str(getattr(x, "dtype", type(x).__name__)))
+                  for x in leaves))
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparedRequest:
+    """A validated request bound to a pipeline: controller built, gate
+    resolved, batching keys derived."""
+
+    request: Request
+    controller: Any
+    gate_step: int
+    scan_steps: int
+    compile_key: Tuple
+    batch_key: Tuple
+
+
+def prepare(req: Request, pipe) -> PreparedRequest:
+    """Validate ``req`` against ``pipe`` and derive its batching keys.
+
+    Raises ``ValueError`` with a human-readable reason on any spec the
+    direct CLI path would also refuse — reusing the CLI's controller
+    factory (``cli.controller_from_opts``) and the sampler's gate
+    resolution/validation (``engine.sampler.resolve_gate``)."""
+    _structural_validate(req)
+
+    from ..cli import controller_from_opts
+    from ..engine.sampler import resolve_gate
+    from ..ops import schedulers as sched_mod
+
+    controller = None
+    if req.target is not None:
+        controller = controller_from_opts(
+            list(req.prompts), pipe.tokenizer, req.steps,
+            mode=req.mode, cross_steps=req.cross_steps,
+            self_steps=req.self_steps, blend_words=req.blend_words,
+            equalizer=req.equalizer, blend_resolution=req.blend_resolution)
+
+    # Same scan length the sampler will run (PLMS warm-up adds one step).
+    schedule = sched_mod.schedule_from_config(req.steps, pipe.config.scheduler,
+                                              kind=req.scheduler)
+    scan_steps = int(schedule.timesteps.shape[0])
+    gate_step = resolve_gate(req.gate, scan_steps, controller)
+
+    compile_key = (pipe.config.name, req.steps, req.scheduler, gate_step,
+                   len(req.prompts), controller_signature(controller))
+    batch_key = compile_key + (float(req.guidance),)
+    return PreparedRequest(request=req, controller=controller,
+                           gate_step=gate_step, scan_steps=scan_steps,
+                           compile_key=compile_key, batch_key=batch_key)

@@ -39,6 +39,23 @@ def default_cache_dir(hash_xla_flags: bool = True) -> str:
     return _DEFAULT_DIR + suffix
 
 
+_ENSURED: dict = {}
+
+
+def ensure_persistent_cache() -> str | None:
+    """:func:`enable_persistent_cache` exactly once per process.
+
+    Long-lived entry points (the serve loop's program cache, anything that
+    builds programs repeatedly) want the persistent XLA cache on without
+    re-running the setup — or re-printing its failure warning — per call.
+    Returns the cache dir of the first (and only) attempt, None if that
+    attempt failed.
+    """
+    if "dir" not in _ENSURED:
+        _ENSURED["dir"] = enable_persistent_cache()
+    return _ENSURED["dir"]
+
+
 def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     """Point JAX's persistent compilation cache at ``cache_dir`` (defaults to
     :func:`default_cache_dir` — a pre-set ``JAX_COMPILATION_CACHE_DIR``, else

@@ -79,10 +79,27 @@ def activate(total: int, label: str = "sampling") -> None:
     set_active(StepReporter(int(total), label))
 
 
+# Secondary sink alongside the rewriting-line reporter: the serve engine
+# installs a per-batch hook here to turn the same compiled-loop callback
+# stream into per-request step progress records (engine_loop.run_entries),
+# without disturbing whatever reporter is active.
+_step_hook = None
+
+
+def set_step_hook(fn) -> None:
+    """Install (or clear, with ``None``) a callable invoked with every step
+    index the compiled loop emits, in addition to the active reporter."""
+    global _step_hook
+    _step_hook = fn
+
+
 def _dispatch(step) -> None:
     r = _active
     if r is not None:
         r(step)
+    h = _step_hook
+    if h is not None:
+        h(step)
 
 
 def emit_step(enabled: bool, step) -> None:

@@ -30,6 +30,10 @@ EXPECTED_KEYS = {
     "reweight_eqsweep_4groups_imgs_per_s",
     "refine_localblend_imgs_per_s",
     "ldm256_8prompt_imgs_per_s",
+    # Request-level serving rehearsal (ISSUE 2): the serve block is a nested
+    # dict (latency percentiles, occupancy, program-cache hit rate) so the
+    # trajectory tracks serving regressions alongside raw throughput.
+    "serve",
     "nullinv_s_per_image",
 }
 
@@ -474,6 +478,11 @@ def test_bench_rehearsal_green_and_complete():
     # Rehearsal must never narrow (a stray P2P_BENCH_SECONDARIES is
     # ignored off-sd14): every block above actually ran.
     assert "narrowed" not in doc
+    # Serving acceptance (ISSUE 2): the loadgen Poisson trace must keep the
+    # batcher at real occupancy with compiles off the request path.
+    assert doc["serve"]["mean_batch_occupancy"] >= 2.0
+    assert doc["serve"]["program_cache_hit_rate"] >= 0.9
+    assert doc["serve"]["p95_ms"] > 0
 
 def test_onchip_provenance_survives_binary_corrupt_artifact(
         tmp_path, monkeypatch):

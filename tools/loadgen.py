@@ -1,0 +1,135 @@
+"""Deterministic synthetic arrival-trace generator for the serve layer.
+
+Emits the serve JSONL request format (``p2p_tpu.serve.request.Request``)
+with virtual ``arrival_ms`` stamps drawn from a seeded RNG — the same seed
+always produces byte-identical traces, so the bench ``serve`` rehearsal and
+the tests replay exactly the load they claim to.
+
+Two arrival processes:
+
+- ``poisson`` — exponential interarrivals at ``--rate`` requests/second:
+  the steady-traffic model the dynamic batcher's occupancy is measured on.
+- ``burst``  — groups of ``--burst-size`` simultaneous arrivals separated
+  by ``--burst-gap-ms`` of silence: the backpressure/queue-depth stressor.
+
+Requests cycle through a small prompt corpus of 2-prompt replace edits
+sharing one compile key (seeds and prompts vary — traced values — so the
+whole trace rides one compiled program per bucket; that is the point of
+compile-key bucketing). ``--distinct-keys N`` spreads the trace over N
+step-counts instead, for cache-pressure experiments.
+
+    python tools/loadgen.py --n 48 --mode poisson --rate 20 --seed 0 \
+        --steps 4 --out demo.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+_CORPUS = (
+    ("a squirrel eating a burger", "a squirrel eating a lasagna"),
+    ("a cat riding a bike", "a dog riding a bike"),
+    ("a painting of a lighthouse", "a painting of a windmill"),
+    ("a bowl of apples on a table", "a bowl of oranges on a table"),
+)
+
+
+def generate_trace(
+    n: int,
+    mode: str = "poisson",
+    rate_per_s: float = 20.0,
+    seed: int = 0,
+    steps: int = 50,
+    scheduler: str = "ddim",
+    burst_size: int = 8,
+    burst_gap_ms: float = 500.0,
+    deadline_ms: Optional[float] = None,
+    distinct_keys: int = 1,
+    gate=None,
+) -> List[dict]:
+    """Build ``n`` request dicts sorted by ``arrival_ms`` (deterministic in
+    ``seed``). See the module docstring for the two modes."""
+    import numpy as np
+
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    if mode not in ("poisson", "burst"):
+        raise ValueError(f"mode must be 'poisson' or 'burst', got {mode!r}")
+    if rate_per_s <= 0:
+        raise ValueError(f"rate_per_s must be positive, got {rate_per_s}")
+    rng = np.random.RandomState(seed)
+    if mode == "poisson":
+        gaps = rng.exponential(1000.0 / rate_per_s, size=n)
+        gaps[0] = 0.0
+        arrivals = np.cumsum(gaps)
+    else:
+        arrivals = np.array([(i // burst_size) * burst_gap_ms
+                             for i in range(n)], dtype=np.float64)
+    out = []
+    for i, at in enumerate(arrivals):
+        src, tgt = _CORPUS[i % len(_CORPUS)]
+        req = {
+            "request_id": f"{mode}-{seed:04d}-{i:04d}",
+            "prompt": src,
+            "target": tgt,
+            "mode": "replace",
+            "steps": steps + (i % distinct_keys if distinct_keys > 1 else 0),
+            "scheduler": scheduler,
+            "seed": int(rng.randint(0, 2 ** 31 - 1)),
+            "arrival_ms": round(float(at), 3),
+        }
+        if gate is not None:
+            req["gate"] = gate
+        if deadline_ms is not None:
+            req["deadline_ms"] = deadline_ms
+        out.append(req)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=48)
+    ap.add_argument("--mode", choices=("poisson", "burst"), default="poisson")
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="poisson arrival rate, requests/second")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--scheduler", choices=("ddim", "plms", "dpm"),
+                    default="ddim")
+    ap.add_argument("--burst-size", type=int, default=8)
+    ap.add_argument("--burst-gap-ms", type=float, default=500.0)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--distinct-keys", type=int, default=1,
+                    help="spread the trace over this many step-counts "
+                         "(distinct compile keys) for cache-pressure runs")
+    ap.add_argument("--gate", default=None,
+                    help="phase-gate spec stamped on every request "
+                         "('auto', a fraction, or a step index)")
+    ap.add_argument("--out", default=None,
+                    help="write the JSONL trace here (default: stdout)")
+    args = ap.parse_args(argv)
+
+    gate = args.gate
+    if isinstance(gate, str) and gate != "auto":
+        gate = float(gate) if "." in gate else int(gate)
+    trace = generate_trace(
+        args.n, mode=args.mode, rate_per_s=args.rate, seed=args.seed,
+        steps=args.steps, scheduler=args.scheduler,
+        burst_size=args.burst_size, burst_gap_ms=args.burst_gap_ms,
+        deadline_ms=args.deadline_ms, distinct_keys=args.distinct_keys,
+        gate=gate)
+    out = open(args.out, "w") if args.out else sys.stdout
+    try:
+        for req in trace:
+            out.write(json.dumps(req) + "\n")
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,6 +79,41 @@ def _phase_gate_drift():
     return float((d ** 2).mean()), float(np.abs(d).max())
 
 
+def _serve_parity():
+    """max|Δ| between one golden replace edit served through the full
+    request path (queue → batcher → program cache → sweep) and the same
+    spec run directly through ``text2image`` — the serving layer's
+    numerics-neutrality contract (ISSUE 2): batching, padding and program
+    caching must be bitwise-invisible. The controller is built through the
+    same shared factory (``cli.controller_from_opts``) on both sides, so
+    the only variable is the serving machinery itself."""
+    import jax
+
+    from p2p_tpu.cli import controller_from_opts
+    from p2p_tpu.engine.sampler import text2image
+    from p2p_tpu.models import TINY
+    from p2p_tpu.serve import Request, serve_forever
+    from tests.test_golden import _pipe
+
+    pipe = _pipe(TINY)
+    steps, seed = 3, 42
+    prompts = ["a squirrel eating a burger", "a squirrel eating a lasagna"]
+    req = Request(request_id="golden", prompt=prompts[0], target=prompts[1],
+                  mode="replace", steps=steps, seed=seed)
+    recs = [r for r in serve_forever(pipe, [req], max_batch=4,
+                                     max_wait_ms=1.0)
+            if r["status"] == "ok"]
+    assert len(recs) == 1, f"serve path produced {len(recs)} ok records"
+    ctrl = controller_from_opts(prompts, pipe.tokenizer, steps,
+                                mode="replace", cross_steps=0.8,
+                                self_steps=0.4)
+    want, _, _ = text2image(pipe, prompts, ctrl, num_steps=steps,
+                            rng=jax.random.PRNGKey(seed))
+    d = np.abs(recs[0]["images"].astype(np.int16)
+               - np.asarray(want).astype(np.int16))
+    return int(d.max())
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--only", default=None,
@@ -92,15 +127,21 @@ def main(argv=None) -> int:
                          "latents (ISSUE 1 drift contract)")
     ap.add_argument("--skip-gate", action="store_true",
                     help="skip the phase-gate drift check")
+    ap.add_argument("--skip-serve", action="store_true",
+                    help="skip the serve-path parity check")
+    ap.add_argument("--serve-max-abs", type=int, default=0,
+                    help="max per-pixel abs diff for the serve-path parity "
+                         "check (default 0: serving must be bitwise "
+                         "numerics-neutral)")
     args = ap.parse_args(argv)
 
     cases, golden_dir, pipe = _cases()
     only = set(args.only.split(",")) if args.only else None
     if only:
-        unknown = only - set(cases) - {"phase_gate"}
+        unknown = only - set(cases) - {"phase_gate", "serve_parity"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
-                     f"valid: {', '.join(cases)}, phase_gate")
+                     f"valid: {', '.join(cases)}, phase_gate, serve_parity")
 
     drifted = []
     for name, fn in cases.items():
@@ -132,6 +173,14 @@ def main(argv=None) -> int:
               f"{'ok' if ok else 'DRIFT'}")
         if not ok:
             drifted.append("phase_gate")
+
+    if not args.skip_serve and (only is None or "serve_parity" in only):
+        mx = _serve_parity()
+        ok = mx <= args.serve_max_abs
+        print(f"{'serve_parity':16s} max|Δ|={mx} vs direct text2image "
+              f"{'ok' if ok else 'DRIFT'}")
+        if not ok:
+            drifted.append("serve_parity")
 
     if drifted:
         print(f"QUALITY GATE FAILED: {', '.join(drifted)} "
