@@ -148,7 +148,7 @@ _TIMEOUT = object()  # sentinel: the inner subprocess hit its timeout
 # past the gate step — an *algorithmic* win, reported with per-phase ms/step
 # so the trajectory can tell it apart from kernel wins).
 _BLOCK_KEYS = ("gsweep", "gate", "dpm", "dpm_batched", "reweight",
-               "refine_blend", "ldm256", "serve", "nullinv")
+               "refine_blend", "ldm256", "serve", "obs", "nullinv")
 
 
 def _secondaries_filter(preset, env_value):
@@ -408,8 +408,12 @@ def main():
                                 budget=min(1800, int(leash)))
             if result is not None and result is not _TIMEOUT:
                 break
+            # Recompute: the child may have burned most of the leash before
+            # exiting — logging the stale pre-launch value overstated what a
+            # relaunch still has to work with.
+            left = patient_end - time.monotonic()
             print(f"patient: child exited without a result; relaunching "
-                  f"({leash:.0f}s had remained)", file=sys.stderr)
+                  f"({left:.0f}s of the leash left)", file=sys.stderr)
             time.sleep(min(240, max(0, patient_end - time.monotonic())))
     elif preset != "tiny" and _probe_accelerator():
         # First attempt gets the longest leash the deadline allows: a cold
@@ -743,6 +747,15 @@ def _measure(preset):
         # the headline metric stays the spec'd 50-step DDIM workload.
         def dpm_batched():
             for g in (8, 4):
+                if time_left() <= 300:
+                    # Each g is a fresh XLA program; never start a compile
+                    # that can't finish (~300s threshold, mirroring the DDIM
+                    # sweep's guard). Checked at the top of the loop: the
+                    # old between-g check could still launch g=8 into a
+                    # near-empty budget and eat the kill there.
+                    note(f"dpm batched g={g} skipped: "
+                         f"{time_left():.0f}s left")
+                    break
                 ctrls_g = broadcast_groups(g, dpm_ctrl["ctrl"])
                 rate = timed(lambda s, g=g, c=ctrls_g: run_batched(
                     g, c, s, steps=20, scheduler="dpm")) * g * len(prompts)
@@ -751,11 +764,6 @@ def _measure(preset):
                 # next g must not lose this one (same contract as the DDIM
                 # g-sweep).
                 report()
-                if g == 8 and time_left() <= 300:
-                    # Each g is a fresh XLA program; don't start a compile
-                    # that can't finish (mirrors the DDIM sweep's threshold).
-                    note(f"dpm batched g=4 skipped: {time_left():.0f}s left")
-                    break
 
         # BASELINE config 3: AttentionReweight equalizer sweep — 4 groups
         # with per-group equalizer scales riding ONE compiled program (the
@@ -881,6 +889,47 @@ def _measure(preset):
                 "prewarm_ms": round(summary["prewarm_ms"], 1),
             }
 
+        # Telemetry-overhead block (ISSUE 3): the same headline single-group
+        # edit run with the obs instrumentation enabled (phase-tagged step
+        # callbacks traced in, host collector installed) vs disabled, so
+        # every BENCH round records what the instrumented path costs — the
+        # bound the quality gate's obs_overhead check enforces, measured on
+        # the round's own hardware. step_events doubles as a liveness
+        # check: 0 means the callback channel was silently mis-wired.
+        def obs_overhead():
+            from p2p_tpu.obs import device as obs_device
+            from p2p_tpu.obs import metrics as obs_metrics
+
+            def run_m(seed, m):
+                img, _, _ = text2image(
+                    pipe, prompts, controller, num_steps=num_steps,
+                    rng=jax.random.PRNGKey(seed), dtype=dtype, metrics=m)
+                return np.asarray(img)
+
+            run_m(0, False)   # warm both programs before timing
+            run_m(0, True)
+            n_runs = 2
+            t0 = time.perf_counter()
+            for i in range(n_runs):
+                run_m(i + 1, False)
+            t_off = (time.perf_counter() - t0) / n_runs
+            obs_metrics.registry().reset()
+            with obs_device.instrument():
+                t0 = time.perf_counter()
+                for i in range(n_runs):
+                    run_m(i + 1, True)
+                t_on = (time.perf_counter() - t0) / n_runs
+            snap = obs_metrics.registry().snapshot()
+            steps_seen = sum(
+                s["value"] for s in snap.get("sampler_steps_total",
+                                             {"samples": []})["samples"])
+            extras["obs"] = {
+                "disabled_s_per_run": round(t_off, 4),
+                "enabled_s_per_run": round(t_on, 4),
+                "overhead_pct": round(max(0.0, t_on / t_off - 1.0) * 100, 2),
+                "step_events": int(steps_seen),
+            }
+
         # Null-text inversion wallclock (BASELINE.json config 4 and part of
         # its metric line; `/root/reference/null_text.py:608-618` workload:
         # 50 DDIM inversion steps + per-step uncond optimization, ≤10 inner
@@ -917,6 +966,7 @@ def _measure(preset):
         secondary("ldm256", "ldm256 secondary", ldm256_batch, needs_sweep=True)
         secondary("serve", "serve rehearsal secondary", serve_rehearsal,
                   needs_sweep=True)
+        secondary("obs", "obs overhead secondary", obs_overhead)
         # min_left=420: the warm-cache need is two sampling-scale passes
         # (~2-3 min); 900 made the metric unreachable inside realistic
         # ~26-min windows (VERDICT r3 weak #4). A cold-cache full run may

@@ -26,6 +26,7 @@ baseline/edited pair like `run_and_display`
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
 import sys
 import time
@@ -69,6 +70,33 @@ def _save(img: np.ndarray, path: str) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     Image.fromarray(np.asarray(img)).save(path)
     print(f"wrote {path}")
+
+
+@contextlib.contextmanager
+def _metrics_session(path: Optional[str]):
+    """``--metrics FILE``: run the block under the telemetry collector and
+    write a Prometheus text snapshot to FILE afterwards.
+
+    Yields the bool to pass as the engines' ``metrics=`` argument (False
+    when no path was given — then nothing extra is traced into any
+    program, the disabled-identity contract). On exit the collector drains
+    the async callback stream, device ``memory_stats()`` gauges are
+    sampled, and the registry (reset at entry, so the snapshot covers
+    exactly this run) is rendered to ``path``."""
+    if not path:
+        yield False
+        return
+    from .obs import device as obs_device
+    from .obs import metrics as obs_metrics
+
+    obs_metrics.registry().reset()
+    with obs_device.instrument():
+        yield True
+    obs_device.sample_device_memory()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        f.write(obs_metrics.registry().to_prometheus())
+    print(f"wrote {path}", file=sys.stderr)
 
 
 def _parse_equalizer(spec: Optional[str]):
@@ -135,18 +163,19 @@ def cmd_generate(args) -> int:
     if args.batch_seeds:
         from .parallel import sweep
 
-        with trace(args.profile):
+        with _metrics_session(args.metrics) as met, trace(args.profile):
             ctx, lats, mesh = _group_setup(pipe, [args.prompt], args.seeds,
                                            args.negative_prompt)
             imgs, _ = sweep(pipe, ctx, lats, None, num_steps=args.steps,
                             guidance_scale=args.guidance,
                             scheduler=args.scheduler, mesh=mesh,
-                            gate=args.gate, progress=not args.quiet)
+                            gate=args.gate, progress=not args.quiet,
+                            metrics=met)
             for i, seed in enumerate(args.seeds):
                 _save(np.asarray(imgs[i][0]), out_path(seed))
         return 0
 
-    with trace(args.profile):
+    with _metrics_session(args.metrics) as met, trace(args.profile):
         for seed in args.seeds:
             img, _, _ = text2image(pipe, [args.prompt], None,
                                    num_steps=args.steps,
@@ -155,7 +184,7 @@ def cmd_generate(args) -> int:
                                    rng=jax.random.PRNGKey(seed),
                                    negative_prompt=args.negative_prompt,
                                    gate=args.gate,
-                                   progress=not args.quiet)
+                                   progress=not args.quiet, metrics=met)
             _save(np.asarray(img[0]), out_path(seed))
     return 0
 
@@ -201,7 +230,8 @@ def _dp_mesh(g, what):
     return make_mesh(n_dev) if n_dev > 1 else None
 
 
-def _edit_batched(args, pipe, prompts, controller, out_dir) -> int:
+def _edit_batched(args, pipe, prompts, controller, out_dir,
+                  metrics: bool = False) -> int:
     """The seed sweep as two compiled programs total (baseline + edit), all
     seeds riding the group axis of the dp sweep engine — the reference's
     sequential per-seed loop (`/root/reference/main.py:417-444`) at sweep
@@ -216,7 +246,7 @@ def _edit_batched(args, pipe, prompts, controller, out_dir) -> int:
                                    args.negative_prompt)
     kw = dict(num_steps=args.steps, guidance_scale=args.guidance,
               scheduler=args.scheduler, mesh=mesh, gate=args.gate,
-              progress=not args.quiet)
+              progress=not args.quiet, metrics=metrics)
     base_imgs, _ = sweep(pipe, ctx, lats, None, **kw)
     ctrls = jax.tree_util.tree_map(
         lambda x: jnp.broadcast_to(x, (g,) + x.shape), controller)
@@ -247,12 +277,13 @@ def cmd_edit(args) -> int:
     controller = _make_controller(args, prompts, pipe.tokenizer, args.steps)
     out_dir = args.out_dir or os.path.join("logs", time.strftime("%y%m%d_%H%M%S"))
     if args.batch_seeds:
-        with trace(args.profile):
-            return _edit_batched(args, pipe, prompts, controller, out_dir)
+        with _metrics_session(args.metrics) as met, trace(args.profile):
+            return _edit_batched(args, pipe, prompts, controller, out_dir,
+                                 metrics=met)
     from .models.config import unet_layout
 
     layout = unet_layout(pipe.config.unet)
-    with trace(args.profile):
+    with _metrics_session(args.metrics) as met, trace(args.profile):
         for seed in args.seeds:
             rng = jax.random.PRNGKey(seed)
             base, x_t, _ = text2image(pipe, prompts, None,
@@ -261,7 +292,8 @@ def cmd_edit(args) -> int:
                                       scheduler=args.scheduler, rng=rng,
                                       negative_prompt=args.negative_prompt,
                                       gate=args.gate,
-                                      progress=not args.quiet, layout=layout)
+                                      progress=not args.quiet, layout=layout,
+                                      metrics=met)
             img, _, store = text2image(pipe, prompts, controller,
                                        num_steps=args.steps,
                                        guidance_scale=args.guidance,
@@ -269,6 +301,7 @@ def cmd_edit(args) -> int:
                                        negative_prompt=args.negative_prompt,
                                        gate=args.gate,
                                        progress=not args.quiet, layout=layout,
+                                       metrics=met,
                                        return_store=bool(args.attn_maps
                                                          or args.self_attn_maps))
             # y / y_hat naming per `/root/reference/main.py:375-380,435-444`.
@@ -333,11 +366,11 @@ def cmd_invert(args) -> int:
 
     pipe = _build_pipeline(args)
     image = load_image(args.image, size=pipe.config.image_size)
-    with trace(args.profile):
+    with _metrics_session(args.metrics) as met, trace(args.profile):
         art = invert(pipe, image, args.prompt, num_steps=args.steps,
                      guidance_scale=args.guidance,
                      num_inner_steps=args.inner_steps,
-                     progress=not args.quiet)
+                     progress=not args.quiet, metrics=met)
     art.save(args.artifact)
     print(f"wrote {args.artifact}")
     if args.out_dir:
@@ -370,7 +403,7 @@ def cmd_replay(args) -> int:
 
     x_t = jnp.asarray(art.x_t)
     ups = jnp.asarray(art.uncond_embeddings)
-    with trace(args.profile):
+    with _metrics_session(args.metrics) as met, trace(args.profile):
         for i, target in enumerate(targets or [None]):
             prompts = [art.prompt, target] if target else [art.prompt]
             controller = (None if target is None else _make_controller(
@@ -378,7 +411,7 @@ def cmd_replay(args) -> int:
             img, _, _ = text2image(
                 pipe, prompts, controller, num_steps=art.num_steps,
                 guidance_scale=args.guidance, latent=x_t,
-                uncond_embeddings=ups, progress=not args.quiet)
+                uncond_embeddings=ups, progress=not args.quiet, metrics=met)
             if i == 0:
                 _save(np.asarray(img[0]),
                       os.path.join(out_dir, "reconstruction.png"))
@@ -402,11 +435,12 @@ def _replay_batched(args, pipe, art, targets, out_dir, edited_path) -> int:
                                   art.num_steps) for t in targets]
     ctx_g, lats, ups, ctrls = artifact_replay_inputs(
         pipe, art.x_t, art.uncond_embeddings, art.prompt, targets, ctrl_list)
-    with trace(args.profile):
+    with _metrics_session(args.metrics) as met, trace(args.profile):
         imgs, _ = sweep(pipe, ctx_g, lats, ctrls, num_steps=art.num_steps,
                         guidance_scale=args.guidance,
                         mesh=_dp_mesh(g, f"--batch-targets: {g} targets"),
-                        uncond_per_step=ups, progress=not args.quiet)
+                        uncond_per_step=ups, progress=not args.quiet,
+                        metrics=met)
         imgs = np.asarray(imgs)
     _save(imgs[0][0], os.path.join(out_dir, "reconstruction.png"))
     for i in range(g):
@@ -421,9 +455,16 @@ def cmd_serve(args) -> int:
     docs/SERVING.md for the request schema."""
     import json
 
+    from .obs import metrics as obs_metrics
+    from .obs import spans as obs_spans
     from .serve import Request, parse_jsonl_line, serve_forever
     from .utils.progress import trace as prof_trace
 
+    # One serve run == one snapshot/event-log: reset before the pipeline
+    # build so prewarm compiles and the queue/batcher/cache timelines are
+    # all covered by the exported artifacts.
+    obs_metrics.registry().reset()
+    obs_spans.clear()
     pipe = _build_pipeline(args)
     stream = sys.stdin if args.requests == "-" else open(args.requests)
     items = []
@@ -473,6 +514,22 @@ def cmd_serve(args) -> int:
     finally:
         if out is not sys.stdout:
             out.close()
+    if args.metrics_out or args.events_out:
+        from .obs import device as obs_device
+
+        obs_device.sample_device_memory()
+        for path, render in ((args.metrics_out,
+                              obs_metrics.registry().to_prometheus),
+                             (args.events_out, None)):
+            if not path:
+                continue
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "w") as f:
+                if render is not None:
+                    f.write(render())
+                else:
+                    obs_spans.write_jsonl(f)
+            print(f"wrote {path}", file=sys.stderr)
     return 0
 
 
@@ -510,7 +567,7 @@ def build_parser() -> argparse.ArgumentParser:
     # Each subcommand declares exactly the flags it honors — no
     # accepted-but-ignored options (the reference's unread `--path
     # config.yaml`, `/root/reference/main.py:388`, is the anti-pattern).
-    def model_opts(sp, guidance=True):
+    def model_opts(sp, guidance=True, metrics=True):
         # Literal name tuples: build_parser must stay jax-free so --help and
         # argparse errors are instant. Drift against the canonical
         # PRESET_CONFIGS map is pinned by
@@ -533,6 +590,15 @@ def build_parser() -> argparse.ArgumentParser:
                         help="suppress per-step progress output")
         sp.add_argument("--profile", default=None, metavar="DIR",
                         help="write a jax.profiler trace of the run to DIR")
+        if metrics:
+            # serve surfaces its own --metrics-out/--events-out pair (the
+            # registry there also carries queue/batcher/cache families).
+            sp.add_argument("--metrics", default=None, metavar="FILE",
+                            help="enable device-side telemetry (per-phase "
+                                 "step timing via the host-callback "
+                                 "channel, memory gauges) and write a "
+                                 "Prometheus text snapshot of the run to "
+                                 "FILE (docs/OBSERVABILITY.md)")
 
     def sampling_opts(sp):
         sp.add_argument("--steps", type=int, default=50)
@@ -628,7 +694,7 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser(
         "serve",
         help="request-level serving: JSONL requests in, JSONL records out")
-    model_opts(s, guidance=False)
+    model_opts(s, guidance=False, metrics=False)
     s.add_argument("--requests", required=True,
                    help="JSONL request trace: a file, a FIFO, or '-' for "
                         "stdin (schema: docs/SERVING.md; generator: "
@@ -654,6 +720,17 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--no-prewarm", action="store_true",
                    help="skip compile-ahead of the first request's program "
                         "(compiles then happen in-band on first dispatch)")
+    s.add_argument("--metrics-out", default=None, metavar="FILE",
+                   help="write a Prometheus text snapshot of the serve "
+                        "telemetry registry (queue depth, stage-latency "
+                        "histograms, program-cache counters, memory "
+                        "gauges) here after the trace drains "
+                        "(docs/OBSERVABILITY.md)")
+    s.add_argument("--events-out", default=None, metavar="FILE",
+                   help="write the structured span event log "
+                        "(serve.prewarm / serve.batch / serve.isolate_retry "
+                        "start/stop events, JSONL) here after the trace "
+                        "drains")
     s.set_defaults(fn=cmd_serve)
 
     c = sub.add_parser(

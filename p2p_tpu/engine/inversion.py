@@ -91,11 +91,11 @@ def load_image(path: str, size: int = 512, left: int = 0, right: int = 0,
     return img
 
 
-@partial(jax.jit, static_argnames=("cfg", "progress", "sp"))
+@partial(jax.jit, static_argnames=("cfg", "progress", "sp", "metrics"))
 def _ddim_invert_jit(unet_params, vae_params, cfg: PipelineConfig,
                      schedule: sched_mod.DiffusionSchedule,
                      image: jax.Array, cond: jax.Array,
-                     progress: bool = False, sp=None):
+                     progress: bool = False, sp=None, metrics: bool = False):
     """image (1,H,W,3) in [-1,1] → all T+1 latents, ascending noise."""
     latent0 = vae_mod.encode(vae_params, cfg.vae, image)
 
@@ -105,7 +105,8 @@ def _ddim_invert_jit(unet_params, vae_params, cfg: PipelineConfig,
 
     def body(latent, scan_in):
         i, t = scan_in
-        progress_mod.emit_step(progress, i)
+        progress_mod.emit_step(progress or metrics, i, phase="invert",
+                               report=progress)
         eps, _ = apply_unet(unet_params, cfg.unet, latent, t, cond, sp=sp)
         eps = sched_mod.to_epsilon(schedule, eps, t, latent)
         nxt = sched_mod.ddim_next_step(schedule, eps, t, latent)
@@ -127,7 +128,7 @@ def _adam_update(g, m, v, j, lr, b1=0.9, b2=0.999, eps=1e-8):
 
 
 @partial(jax.jit, static_argnames=("cfg", "num_inner_steps", "progress",
-                                   "sp"))
+                                   "sp", "metrics"))
 def _null_optimize_jit(unet_params, cfg: PipelineConfig,
                        schedule: sched_mod.DiffusionSchedule,
                        latents: jax.Array,        # (T+1, 1, h, w, c) ascending
@@ -136,7 +137,8 @@ def _null_optimize_jit(unet_params, cfg: PipelineConfig,
                        guidance_scale: jax.Array,
                        num_inner_steps: int,
                        epsilon: jax.Array,
-                       progress: bool = False, sp=None):
+                       progress: bool = False, sp=None,
+                       metrics: bool = False):
     """Per-timestep uncond-embedding optimization
     (`/root/reference/null_text.py:574-606`). Returns (T, 1, L, D) f32.
 
@@ -153,7 +155,8 @@ def _null_optimize_jit(unet_params, cfg: PipelineConfig,
     def outer(carry, scan_in):
         latent_cur, uncond = carry
         i, t = scan_in
-        progress_mod.emit_step(progress, i)
+        progress_mod.emit_step(progress or metrics, i, phase="null_text",
+                               report=progress)
         # Reference decay is the literal `1e-2 * (1 - i/100)` at T=50
         # (`/root/reference/null_text.py:582`) — i.e. lr halves over the run.
         # Generalized as i/(2T): identical numbers at T=50, and the schedule
@@ -199,7 +202,13 @@ def _null_optimize_jit(unet_params, cfg: PipelineConfig,
 
         init = (uncond, jnp.zeros_like(uncond), jnp.zeros_like(uncond),
                 jnp.float32(0.0), jnp.float32(jnp.inf))
-        u_opt, _, _, _, _ = jax.lax.while_loop(inner_cond, inner_body, init)
+        u_opt, _, _, j_done, _ = jax.lax.while_loop(inner_cond, inner_body,
+                                                    init)
+        # Inner-iteration telemetry: how many Adam steps each outer step
+        # actually ran before the early-stop bar (the distribution is the
+        # knob num_inner_steps should be tuned against). Traced value,
+        # static tag; nothing is traced in when metrics is off.
+        progress_mod.emit_event(metrics, "invert.inner_steps", j_done)
 
         # Advance with the optimized uncond under full CFG
         # (`/root/reference/null_text.py:602-604`).
@@ -230,6 +239,7 @@ def invert(
     progress: bool = False,
     sp=None,
     gate=None,
+    metrics: bool = False,
 ) -> InversionArtifact:
     """Full null-text inversion (`/root/reference/null_text.py:608-618`):
     DDIM-invert with guidance 1, then optimize per-step uncond embeddings so
@@ -246,7 +256,13 @@ def invert(
     self-attention sites with ring attention through both compiled
     programs — including the optimization's gradient, which recomputes
     ring-flash blocks through the einsum VJP (`parallel/ring.py`). The
-    long-context path for inverting high-resolution images."""
+    long-context path for inverting high-resolution images.
+
+    ``metrics`` traces the telemetry callbacks into both programs
+    (phase-tagged step timing plus the per-outer-step inner-iteration count
+    as an ``invert.inner_steps`` host event); collected when the caller
+    installed ``obs.device.instrument`` (the CLI ``--metrics`` flag does).
+    Disabled, both compiled programs are unchanged."""
     if gate is not None and gate != num_steps:
         raise ValueError(
             f"null-text inversion is incompatible with phase-gated sampling "
@@ -271,20 +287,25 @@ def invert(
     cond = encode_prompts(pipe, [prompt], dtype=dtype)
     uncond0 = encode_prompts(pipe, [""], dtype=dtype)
 
+    from ..obs.spans import span
+
     if progress:
         progress_mod.activate(num_steps, "ddim-invert")
-    latent0, x_t, all_latents = _ddim_invert_jit(
-        pipe.unet_params, pipe.vae_params, cfg, schedule, image_j, cond,
-        progress=progress, sp=sp)
+    with span("invert.ddim", steps=num_steps):
+        latent0, x_t, all_latents = _ddim_invert_jit(
+            pipe.unet_params, pipe.vae_params, cfg, schedule, image_j, cond,
+            progress=progress, sp=sp, metrics=metrics)
 
     if progress:
         # activate() drains phase-1 callbacks first (block_until_ready only
         # waits on the computation, not on host callback delivery).
         progress_mod.activate(num_steps, "null-text opt")
-    uncond_list = _null_optimize_jit(
-        pipe.unet_params, cfg, schedule, all_latents, uncond0, cond, gs,
-        num_inner_steps, jnp.float32(early_stop_epsilon), progress=progress,
-        sp=sp)
+    with span("invert.null_optimize", steps=num_steps,
+              inner_steps=num_inner_steps):
+        uncond_list = _null_optimize_jit(
+            pipe.unet_params, cfg, schedule, all_latents, uncond0, cond, gs,
+            num_inner_steps, jnp.float32(early_stop_epsilon),
+            progress=progress, sp=sp, metrics=metrics)
 
     rec = vae_mod.to_uint8(vae_mod.decode(
         pipe.vae_params, cfg.vae, latent0.astype(jnp.float32)))

@@ -179,6 +179,7 @@ def _denoise_scan(
     progress: bool = False,
     sp: Optional["SpConfig"] = None,
     gate: Optional[int] = None,    # static: first phase-2 scan step; None/S = off
+    metrics: bool = False,         # static: trace the telemetry callback in
 ) -> Tuple[jax.Array, StoreState]:
     """Scan over timesteps. Returns (final latents, final store state).
 
@@ -198,7 +199,13 @@ def _denoise_scan(
 
     ``gate=None`` (or ``gate == S``) compiles the exact pre-existing
     single-scan program — bitwise-identical output, zero new ops.
+
+    ``metrics`` traces the per-step host callback in even when ``progress``
+    is off (phase-tagged, so ``obs.device.StepCollector`` can histogram
+    phase-1 vs phase-2 ms/step); with both off the program carries no
+    callback at all — the telemetry-disabled jaxpr-identity contract.
     """
+    emit = progress or metrics
     b = latents.shape[0]
     state = (init_store_state(layout, b, dtype=jnp.float32)
              if (controller is not None and controller.needs_store) else ())
@@ -232,7 +239,7 @@ def _denoise_scan(
         else:
             latents, state, ms = carry
         step, t = scan_in
-        progress_mod.emit_step(progress, step)
+        progress_mod.emit_step(emit, step, phase="phase1", report=progress)
         ctx = context
         if uncond_per_step is not None:
             # Null-text: substitute this step's optimized uncond embedding.
@@ -296,7 +303,7 @@ def _denoise_scan(
     def body2(carry, scan_in):
         latents, ms = carry
         step, t = scan_in
-        progress_mod.emit_step(progress, step)
+        progress_mod.emit_step(emit, step, phase="phase2", report=progress)
         eps_text, _ = apply_unet(
             unet_params, cfg.unet, latents, t, context_cond,
             layout=layout, controller=None, state=(), step=step, sp=sp,
@@ -320,7 +327,8 @@ def _denoise_scan(
 
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
-                                   "return_store", "progress", "sp", "gate"))
+                                   "return_store", "progress", "sp", "gate",
+                                   "metrics"))
 def _text2image_jit(
     unet_params: Any,
     vae_params: Any,
@@ -338,12 +346,13 @@ def _text2image_jit(
     progress: bool = False,
     sp: Optional["SpConfig"] = None,
     gate: Optional[int] = None,
+    metrics: bool = False,
 ):
     context = jnp.concatenate([context_uncond, context_cond], axis=0)
     latents, state = _denoise_scan(
         unet_params, cfg, layout, schedule, scheduler_kind, context, latents,
         controller, guidance_scale, uncond_per_step, progress=progress, sp=sp,
-        gate=gate)
+        gate=gate, metrics=metrics)
     image = vae_mod.decode(vae_params, cfg.vae, latents.astype(jnp.float32))
     image = vae_mod.to_uint8(image)
     return (image, latents, state) if return_store else (image, latents, ())
@@ -367,6 +376,7 @@ def text2image(
     progress: bool = False,
     sp: Optional["SpConfig"] = None,
     gate=None,
+    metrics: bool = False,
 ):
     """Generate an edit group of images from prompts under attention control —
     the `/root/reference/ptp_utils.py:129-172` entry point.
@@ -391,6 +401,15 @@ def text2image(
     branch at *every* step, so truncating it would silently misalign the
     replay — rejected with an error instead. Returns
     ``(images uint8 (B,H,W,3), x_T, store)``.
+
+    ``metrics`` enables device-side telemetry (docs/OBSERVABILITY.md):
+    phase-tagged step callbacks are traced into the program and the resolved
+    gate step / scan length / CFG batch land in the default registry as
+    gauges. Numerics-neutral — callbacks are pure side channel — and with
+    ``metrics=False`` (and ``progress=False``) the compiled program is
+    identical to one built before this flag existed. Callers that want the
+    step stream collected must install the host sink
+    (``obs.device.instrument``); the CLI ``--metrics`` flag does.
     """
     if negative_prompt and uncond_embeddings is not None:
         raise ValueError("negative_prompt and uncond_embeddings are mutually "
@@ -439,9 +458,30 @@ def text2image(
     x_t, latents = init_latent(latent, pipe.latent_shape, rng, len(prompts), dtype)
     if progress:
         progress_mod.activate(schedule.timesteps.shape[0])
-    image, latents_out, state = _text2image_jit(
-        pipe.unet_params, pipe.vae_params, cfg, layout, schedule, scheduler,
-        context_cond, context_uncond, latents, controller, gs,
-        uncond_embeddings, return_store, progress=progress, sp=sp,
-        gate=gate_step)
+    if metrics:
+        # Host-side run descriptors for the snapshot: the gate decomposition
+        # (per-phase ms/step arrives via the step callbacks) plus the CFG
+        # batch shape phase 1 actually runs.
+        from ..obs import metrics as obs_metrics
+
+        reg = obs_metrics.registry()
+        reg.gauge("sampler_gate_step",
+                  "first phase-2 scan step (== scan length: ungated)"
+                  ).set(float(gate_step))
+        reg.gauge("sampler_scan_steps", "scan length").set(float(num_scan))
+        reg.gauge("sampler_cfg_batch",
+                  "CFG-doubled U-Net batch in phase 1 (2B)"
+                  ).set(float(2 * len(prompts)))
+    from ..obs.spans import span
+
+    with span("sampler.text2image", steps=int(num_scan), gate=int(gate_step),
+              batch=len(prompts)):
+        # Span covers trace/compile + async dispatch (execution completes
+        # when the caller materializes the arrays) — it marks the host
+        # region for Perfetto alignment, not device wall time.
+        image, latents_out, state = _text2image_jit(
+            pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
+            scheduler, context_cond, context_uncond, latents, controller, gs,
+            uncond_embeddings, return_store, progress=progress, sp=sp,
+            gate=gate_step, metrics=metrics)
     return image, x_t, state

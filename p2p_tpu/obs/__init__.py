@@ -1,0 +1,29 @@
+"""Unified telemetry: metrics registry, span tracing, device instrumentation.
+
+Three dependency-free modules every other subsystem reports through (see
+docs/OBSERVABILITY.md for the metric catalog and span taxonomy):
+
+- :mod:`.metrics` — process-global registry of counters, gauges and
+  fixed-bucket histograms with labeled families, snapshot/reset semantics,
+  Prometheus text exposition and JSONL export.
+- :mod:`.spans` — nested wall-clock spans in a bounded ring buffer,
+  mirrored into ``jax.profiler.TraceAnnotation`` so host spans line up
+  with device xplane traces.
+- :mod:`.device` — the host half of the compiled-loop callback channel
+  (``utils.progress.emit_step``/``emit_event``): per-phase step timing,
+  compile-time recording, device ``memory_stats()`` gauges. Imported
+  explicitly (``from p2p_tpu.obs import device``) because it pulls jax;
+  this package root stays jax-free so CLI parsing and the serve data
+  structures can import metrics/spans without a backend.
+
+The TPU-native discipline: disabling telemetry traces *nothing* into any
+XLA program (the ``emit_step(enabled=False)`` contract, pinned by jaxpr
+identity tests), and everything here is host-side — enabling it changes
+wall-clock overhead only, never numerics.
+"""
+
+from . import metrics, spans  # noqa: F401  (device is imported explicitly)
+from .metrics import registry  # noqa: F401
+from .spans import span  # noqa: F401
+
+__all__ = ["metrics", "spans", "registry", "span"]

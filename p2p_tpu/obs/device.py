@@ -1,0 +1,139 @@
+"""Step-level device-side instrumentation: the host end of the callback
+channel ``utils.progress`` traces into compiled programs.
+
+``utils.progress.emit_step``/``emit_event`` are the *trace-time* half: when
+(and only when) a program was compiled with telemetry or progress enabled,
+each scan step fires an async ``jax.debug.callback`` carrying the step index
+(tagged with its phase) or a (tag, value) pair. This module is the host
+half: :func:`instrument` installs a :class:`StepCollector` as the progress
+module's obs sink for the duration of a block, timestamping step boundaries
+as the callbacks land and folding them into the default metrics registry:
+
+- ``sampler_step_ms{phase=...}`` — host-observed ms/step per phase
+  (``phase1``/``phase2`` for the gated sampler, ``invert``/``null_text``
+  for the inversion programs). Async callbacks arrive unordered; deltas
+  are only taken between increasing step indices, the same monotonic
+  discipline as ``progress.StepReporter``.
+- ``sampler_steps_total{phase=...}`` — callback count (a liveness check:
+  zero events under an enabled run means the channel is mis-wired).
+- ``host_event_value{tag=...}`` — generic traced-value events
+  (e.g. ``invert.inner_steps``, the per-outer-step null-text inner
+  iteration count).
+
+:func:`sample_device_memory` reads ``jax.local_devices()[0].memory_stats()``
+into ``device_memory_bytes{stat=...}`` gauges — present on TPU backends,
+silently absent on CPU (the method returns None there), never an error.
+
+:func:`record_compile` is the shared counter for compile/build time hits —
+``serve.programs.ProgramCache`` reports each miss's build wall time here so
+the registry can answer "how much of this window went to compiles".
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+from ..utils import progress as progress_mod
+from . import metrics as metrics_mod
+
+
+class StepCollector:
+    """Host sink for compiled-loop step/event callbacks (see module doc)."""
+
+    def __init__(self, registry: Optional[metrics_mod.Registry] = None):
+        reg = registry or metrics_mod.registry()
+        self._step_ms = reg.histogram(
+            "sampler_step_ms", "host-observed sampling step time by phase",
+            labels=("phase",), buckets=metrics_mod.STEP_MS_BUCKETS)
+        self._steps = reg.counter(
+            "sampler_steps_total", "step callbacks received by phase",
+            labels=("phase",))
+        self._events = reg.histogram(
+            "host_event_value", "traced host-event values by tag",
+            labels=("tag",), buckets=metrics_mod.COUNT_BUCKETS)
+        # phase -> (last step index, host perf_counter at that step)
+        self._last = {}
+
+    # The progress-module sink protocol: ("step", index, phase) for step
+    # callbacks, (tag, value, None) for generic events.
+    def __call__(self, tag: str, value, phase=None) -> None:
+        if tag == "step":
+            self.on_step(int(value), phase)
+        else:
+            self._events.labels(tag=str(tag)).observe(float(value))
+
+    def on_step(self, step: int, phase) -> None:
+        label = str(phase) if phase is not None else "main"
+        now = time.perf_counter()
+        self._steps.labels(phase=label).inc()
+        last = self._last.get(label)
+        if last is None:
+            self._last[label] = (step, now)
+        elif step > last[0]:
+            dt_ms = (now - last[1]) / (step - last[0]) * 1000.0
+            self._step_ms.labels(phase=label).observe(dt_ms)
+            self._last[label] = (step, now)
+        elif step < last[0]:
+            # Step index went backwards: a NEW run started under the same
+            # collector (multi-seed CLI loop, bench repeats) — re-arm the
+            # timeline without observing, or every run after the first
+            # would be silently dropped from the histogram. (A same-run
+            # async late arrival can land here too; the reset only skews
+            # the one next delta, bounded, vs losing whole runs.)
+            self._last[label] = (step, now)
+        # step == last[0]: duplicate delivery — ignore.
+
+
+@contextlib.contextmanager
+def instrument(registry: Optional[metrics_mod.Registry] = None):
+    """Install a :class:`StepCollector` as the progress obs sink for the
+    block. On exit the in-flight callback stream is drained
+    (``jax.effects_barrier`` — dispatch is async) before the sink is
+    removed, so late steps land in the collector instead of vanishing."""
+    collector = StepCollector(registry)
+    progress_mod.set_obs_sink(collector)
+    try:
+        yield collector
+    finally:
+        try:
+            import jax
+
+            jax.effects_barrier()
+        except Exception:
+            pass
+        progress_mod.set_obs_sink(None)
+
+
+def sample_device_memory(
+        registry: Optional[metrics_mod.Registry] = None) -> dict:
+    """Sample the first local device's ``memory_stats()`` into gauges.
+    Returns the sampled dict ({} when the backend exposes nothing — CPU)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        return {}
+    reg = registry or metrics_mod.registry()
+    gauge = reg.gauge("device_memory_bytes",
+                      "jax device memory_stats() samples", labels=("stat",))
+    out = {}
+    for key, val in stats.items():
+        if isinstance(val, (int, float)):
+            gauge.labels(stat=str(key)).set(float(val))
+            out[str(key)] = val
+    return out
+
+
+def record_compile(ms: float, what: str = "program",
+                   registry: Optional[metrics_mod.Registry] = None) -> None:
+    """One compile/build observation (``what``: e.g. 'program', 'prewarm')."""
+    reg = registry or metrics_mod.registry()
+    reg.counter("compiles_total", "program builds recorded",
+                labels=("what",)).labels(what=what).inc()
+    reg.histogram("compile_ms", "program build/warm wall time",
+                  labels=("what",),
+                  buckets=metrics_mod.LATENCY_MS_BUCKETS
+                  ).labels(what=what).observe(float(ms))

@@ -1,0 +1,353 @@
+"""Process-global metrics registry: counters, gauges, bucket histograms.
+
+The serving loop and the sampler need numbers that survive past a single
+record stream — queue depth over time, per-phase step-time distributions,
+program-cache hit rates — without dragging a metrics dependency into the
+image. This module is that substrate: dependency-free (stdlib only),
+single-process (the serve loop is single-threaded by design; a lock guards
+only family registration for safety), and cheap enough to leave on.
+
+Design points, in the Prometheus idiom but trimmed to what this repo uses:
+
+- **Families, not bare metrics.** ``registry().counter(name, help,
+  labels=("status",))`` returns a :class:`Family`; ``family.labels(
+  status="ok")`` returns the child :class:`Counter`. Registration is
+  get-or-create and idempotent — re-declaring the same family from another
+  module returns the existing one; a kind/label mismatch raises (two
+  subsystems silently sharing a name with different shapes is a bug).
+- **Histograms store buckets, never samples.** A fixed, monotonically
+  increasing bound tuple; ``observe`` bumps one cumulative-style bucket
+  count plus sum/count. p50/p95/p99 come from :meth:`Histogram.quantile`
+  by linear interpolation inside the owning bucket — bounded memory no
+  matter how many requests flow through, at bucket-width resolution (the
+  acceptance contract everywhere is "agrees within one bucket").
+- **snapshot/reset.** :meth:`Registry.snapshot` returns plain dicts (the
+  JSONL export unit); :meth:`Registry.reset` zeroes every child *in place*
+  so long-lived references (e.g. a ``ProgramCache``'s counters) stay live
+  across serve runs.
+- **Exposition.** :meth:`Registry.to_prometheus` renders the text format
+  (``# HELP``/``# TYPE``, ``_bucket{le=...}``/``_sum``/``_count``);
+  :meth:`Registry.write_jsonl` writes one JSON line per sample.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+# Shared bound sets. Milli­second latencies span queue waits (sub-ms on the
+# virtual clock) to cold compiles (minutes); step times are tighter.
+LATENCY_MS_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                      1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0,
+                      180000.0)
+STEP_MS_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                   500.0, 1000.0, 2500.0, 5000.0, 15000.0)
+# Small-integer distributions: batch occupancy, inner-iteration counts.
+COUNT_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0,
+                 64.0, 128.0)
+
+
+class Counter:
+    """Monotonic accumulator (``inc`` only)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter increments must be >= 0, got {n}")
+        self.value += n
+
+    def _zero(self) -> None:
+        self.value = 0.0
+
+    def _sample(self) -> dict:
+        return {"value": self.value}
+
+
+class Gauge:
+    """Point-in-time value (``set``/``add``)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def add(self, n: float = 1.0) -> None:
+        self.value += n
+
+    def _zero(self) -> None:
+        self.value = 0.0
+
+    def _sample(self) -> dict:
+        return {"value": self.value}
+
+
+class Histogram:
+    """Fixed-bound bucket histogram; quantiles from buckets, no samples.
+
+    ``bounds`` are the finite upper bounds (ascending); an implicit +Inf
+    bucket catches the tail. ``counts[i]`` is the number of observations
+    ``<= bounds[i]`` exclusive of lower buckets (per-bucket, cumulated only
+    at exposition time, which keeps ``observe`` one index + two adds)."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Tuple[float, ...]):
+        if not bounds or list(bounds) != sorted(set(bounds)):
+            raise ValueError(f"histogram bounds must be ascending and "
+                             f"non-empty, got {bounds!r}")
+        self.bounds = tuple(float(b) for b in bounds)
+        self.counts = [0] * (len(self.bounds) + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.counts[self.bucket_index(v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def bucket_index(self, v: float) -> int:
+        """Index of the bucket ``v`` falls into (len(bounds) = the +Inf
+        tail). Exposed so tests can assert 'within one bucket'."""
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                return i
+        return len(self.bounds)
+
+    def quantile(self, q: float) -> float:
+        """Estimate the ``q``-quantile (q in [0, 1]) from bucket counts:
+        linear interpolation between the owning bucket's bounds (lower bound
+        0 for the first bucket; the +Inf bucket reports its finite floor —
+        the honest answer bounded storage can give)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                if i >= len(self.bounds):       # +Inf tail
+                    return self.bounds[-1]
+                lo = self.bounds[i - 1] if i else 0.0
+                hi = self.bounds[i]
+                frac = (rank - cum) / c
+                return lo + (hi - lo) * max(0.0, min(1.0, frac))
+            cum += c
+        return self.bounds[-1]
+
+    def _zero(self) -> None:
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def _sample(self) -> dict:
+        cum, buckets = 0, []
+        for b, c in zip(self.bounds, self.counts):
+            cum += c
+            buckets.append([b, cum])
+        return {"count": self.count, "sum": self.sum, "buckets": buckets}
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class Family:
+    """One named metric family: a kind, label names, and labeled children."""
+
+    def __init__(self, name: str, kind: str, help: str,
+                 label_names: Tuple[str, ...],
+                 buckets: Optional[Tuple[float, ...]] = None):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.label_names = tuple(label_names)
+        self.buckets = tuple(buckets) if buckets else None
+        self._children: Dict[Tuple[str, ...], object] = {}
+
+    def labels(self, **kv):
+        """The child at these label values (created on first use)."""
+        if set(kv) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: labels {sorted(kv)} != declared "
+                f"{sorted(self.label_names)}")
+        key = tuple(str(kv[n]) for n in self.label_names)
+        child = self._children.get(key)
+        if child is None:
+            child = (Histogram(self.buckets) if self.kind == "histogram"
+                     else _KINDS[self.kind]())
+            self._children[key] = child
+        return child
+
+    # Unlabeled families act as the metric itself (the common case).
+    def _default(self):
+        return self.labels()
+
+    def inc(self, n: float = 1.0) -> None:
+        self._default().inc(n)
+
+    def set(self, v: float) -> None:
+        self._default().set(v)
+
+    def add(self, n: float = 1.0) -> None:
+        self._default().add(n)
+
+    def observe(self, v: float) -> None:
+        self._default().observe(v)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+    def quantile(self, q: float):
+        return self._default().quantile(q)
+
+    def bucket_index(self, v: float):
+        return self._default().bucket_index(v)
+
+    @property
+    def count(self):
+        return self._default().count
+
+    @property
+    def sum(self):
+        return self._default().sum
+
+    def samples(self) -> Iterable[Tuple[Dict[str, str], object]]:
+        for key, child in sorted(self._children.items()):
+            yield dict(zip(self.label_names, key)), child
+
+    def _zero(self) -> None:
+        for child in self._children.values():
+            child._zero()
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _label_str(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_escape(v)}"' for k, v in labels.items()]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+class Registry:
+    """Named families, get-or-create. One process-global default instance
+    (:func:`registry`); tests may build private ones."""
+
+    def __init__(self):
+        self._families: Dict[str, Family] = {}
+        self._lock = threading.Lock()
+
+    def _family(self, name: str, kind: str, help: str,
+                labels: Tuple[str, ...],
+                buckets: Optional[Tuple[float, ...]] = None) -> Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind or fam.label_names != tuple(labels) or (
+                        kind == "histogram" and buckets
+                        and fam.buckets != tuple(buckets)):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{fam.kind}{fam.label_names} — cannot re-register "
+                        f"as {kind}{tuple(labels)}")
+                return fam
+            fam = Family(name, kind, help, labels, buckets)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labels: Tuple[str, ...] = ()) -> Family:
+        return self._family(name, "counter", help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Tuple[str, ...] = ()) -> Family:
+        return self._family(name, "gauge", help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Tuple[str, ...] = (),
+                  buckets: Tuple[float, ...] = LATENCY_MS_BUCKETS) -> Family:
+        return self._family(name, "histogram", help, labels, buckets)
+
+    def get(self, name: str) -> Optional[Family]:
+        return self._families.get(name)
+
+    def snapshot(self) -> dict:
+        """Plain-dict view of every family (the JSONL export unit)."""
+        out = {}
+        for name, fam in sorted(self._families.items()):
+            out[name] = {
+                "type": fam.kind, "help": fam.help,
+                "samples": [{"labels": labels, **child._sample()}
+                            for labels, child in fam.samples()],
+            }
+        return out
+
+    def reset(self) -> None:
+        """Zero every child in place: families (and references to their
+        children) survive, values restart — the between-runs semantics the
+        CLI uses so one snapshot covers one run."""
+        for fam in self._families.values():
+            fam._zero()
+
+    def to_prometheus(self) -> str:
+        """Text exposition format (the ``--metrics-out`` artifact)."""
+        lines = []
+        for name, fam in sorted(self._families.items()):
+            if fam.help:
+                lines.append(f"# HELP {name} {fam.help}")
+            lines.append(f"# TYPE {name} {fam.kind}")
+            for labels, child in fam.samples():
+                if fam.kind == "histogram":
+                    cum = 0
+                    for b, c in zip(child.bounds, child.counts):
+                        cum += c
+                        le = 'le="%g"' % b
+                        lines.append(f"{name}_bucket"
+                                     f"{_label_str(labels, le)} {cum}")
+                    inf = 'le="+Inf"'
+                    lines.append(f"{name}_bucket{_label_str(labels, inf)}"
+                                 f" {child.count}")
+                    lines.append(f"{name}_sum{_label_str(labels)}"
+                                 f" {_fmt(child.sum)}")
+                    lines.append(f"{name}_count{_label_str(labels)}"
+                                 f" {child.count}")
+                else:
+                    lines.append(f"{name}{_label_str(labels)}"
+                                 f" {_fmt(child.value)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def write_jsonl(self, fp) -> int:
+        """One JSON line per sample to an open file; returns lines written."""
+        n = 0
+        for name, fam in sorted(self._families.items()):
+            for labels, child in fam.samples():
+                fp.write(json.dumps({"metric": name, "type": fam.kind,
+                                     "labels": labels, **child._sample()})
+                         + "\n")
+                n += 1
+        return n
+
+
+_default = Registry()
+
+
+def registry() -> Registry:
+    """The process-global registry every instrumented subsystem shares."""
+    return _default

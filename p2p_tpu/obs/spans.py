@@ -1,0 +1,157 @@
+"""Nested wall-clock spans with a bounded ring buffer of structured events.
+
+``with span("serve.batch", lanes=4): ...`` records a start and an end event
+(name, span id, parent id, nesting depth, relative timestamp, attributes,
+duration) into a fixed-capacity ring buffer — old events are evicted, never
+buffered unboundedly — and mirrors the block into
+``jax.profiler.TraceAnnotation`` so the same named region shows up on the
+host rows of an xplane/Perfetto trace captured with ``utils.progress.trace``
+(docs/OBSERVABILITY.md shows how to line the two up). Span durations are
+additionally observed into the ``span_duration_ms`` histogram of the default
+metrics registry, so the Prometheus snapshot carries the per-span-name
+distribution even after the ring has evicted the events.
+
+Host-side only: entering a span never traces anything into an XLA program
+(``TraceAnnotation`` is a profiler marker, not an op), so the
+telemetry-disabled jaxpr-identity guarantee is unaffected by spans entirely.
+``set_enabled(False)`` turns :func:`span` into a pure pass-through for
+callers who want zero event traffic.
+
+Timestamps are milliseconds on a module-local ``perf_counter`` epoch —
+monotonic and comparable across events of one process, not wall-clock.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import time
+from collections import deque
+from typing import List, Optional
+
+from . import metrics as metrics_mod
+
+DEFAULT_CAPACITY = 4096
+
+_EPOCH = time.perf_counter()
+
+
+def _now_ms() -> float:
+    return (time.perf_counter() - _EPOCH) * 1000.0
+
+
+class SpanRecorder:
+    """Bounded event sink. ``dropped`` counts ring-evicted events so an
+    export can say it is a suffix, not the whole run."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._ring: deque = deque(maxlen=capacity)
+        self.total = 0
+
+    @property
+    def dropped(self) -> int:
+        return self.total - len(self._ring)
+
+    def emit(self, event: dict) -> None:
+        self._ring.append(event)
+        self.total += 1
+
+    def events(self) -> List[dict]:
+        return list(self._ring)
+
+    def clear(self) -> None:
+        self._ring.clear()
+        self.total = 0
+
+
+_recorder = SpanRecorder()
+_stack: List[int] = []           # active span ids, innermost last
+_ids = itertools.count(1)
+_enabled = True
+
+
+def set_enabled(flag: bool) -> None:
+    global _enabled
+    _enabled = bool(flag)
+
+
+def recorder() -> SpanRecorder:
+    return _recorder
+
+
+def events() -> List[dict]:
+    return _recorder.events()
+
+
+def clear() -> None:
+    _recorder.clear()
+
+
+def _trace_annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation`` for ``name``, or None when jax (or
+    its profiler) is unavailable — spans must not *require* jax."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return None
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Record a nested wall-clock span around the block.
+
+    ``attrs`` must be JSON-serializable scalars (lane counts, step counts,
+    cache-hit flags); they ride both the start and end events."""
+    if not _enabled:
+        yield None
+        return
+    sid = next(_ids)
+    parent = _stack[-1] if _stack else None
+    depth = len(_stack)
+    t0 = time.perf_counter()
+    _recorder.emit({"event": "span_start", "span": sid, "name": name,
+                    "parent": parent, "depth": depth, "ts_ms": _now_ms(),
+                    **attrs})
+    _stack.append(sid)
+    ann = _trace_annotation(name)
+    if ann is not None:
+        ann.__enter__()
+    try:
+        yield sid
+    finally:
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        _stack.pop()
+        dur_ms = (time.perf_counter() - t0) * 1000.0
+        _recorder.emit({"event": "span_end", "span": sid, "name": name,
+                        "parent": parent, "depth": depth, "ts_ms": _now_ms(),
+                        "dur_ms": dur_ms, **attrs})
+        metrics_mod.registry().histogram(
+            "span_duration_ms", "wall-clock span durations by span name",
+            labels=("name",),
+            buckets=metrics_mod.LATENCY_MS_BUCKETS,
+        ).labels(name=name).observe(dur_ms)
+
+
+def write_jsonl(fp) -> int:
+    """Dump the ring buffer as JSONL to an open file; returns lines written.
+    A leading meta line records capacity/total/dropped so consumers know
+    whether the log is complete."""
+    fp.write(json.dumps({"event": "meta", "total": _recorder.total,
+                         "dropped": _recorder.dropped}) + "\n")
+    n = 1
+    for ev in _recorder.events():
+        fp.write(json.dumps(ev) + "\n")
+        n += 1
+    return n
+
+
+def active_depth() -> int:
+    return len(_stack)
+
+
+def active_span() -> Optional[int]:
+    return _stack[-1] if _stack else None

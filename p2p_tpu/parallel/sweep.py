@@ -28,7 +28,7 @@ from ..ops import schedulers as sched_mod
 
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
-                                   "progress", "gate"),
+                                   "progress", "gate", "metrics"),
          donate_argnums=())
 def _sweep_jit(
     unet_params: Any,
@@ -44,14 +44,17 @@ def _sweep_jit(
     uncond_per_step: Optional[jax.Array],  # (G, T, 1, L, D) or None
     progress: bool = False,
     gate: Optional[int] = None,
+    metrics: bool = False,
 ):
     def one_group(ctx, lat, ctrl, ups):
         # The scanned step index is vmap-invariant (built inside the scan,
         # independent of the batched inputs), so the progress callback fires
-        # once per step — not once per group.
+        # once per step — not once per group. The same holds for the
+        # telemetry callback (metrics=True).
         lat, state = _denoise_scan(
             unet_params, cfg, layout, schedule, scheduler_kind, ctx, lat, ctrl,
-            guidance_scale, uncond_per_step=ups, progress=progress, gate=gate)
+            guidance_scale, uncond_per_step=ups, progress=progress, gate=gate,
+            metrics=metrics)
         image = vae_mod.decode(vae_params, cfg.vae, lat.astype(jnp.float32))
         return vae_mod.to_uint8(image), lat
 
@@ -72,6 +75,7 @@ def sweep(
     uncond_per_step: Optional[jax.Array] = None,
     progress: bool = False,
     gate=None,
+    metrics: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Run G independent edit groups; shard the group axis over ``dp``.
 
@@ -95,8 +99,10 @@ def sweep(
     ``context`` are caller-encoded, so a per-group negative prompt is just
     a different uncond half. ``progress=True`` reports per-step progress
     exactly like ``text2image`` (the scanned step index is group-invariant,
-    so the sweep emits one callback per step). Returns
-    ``(images (G,B,H,W,3) uint8, final latents)``.
+    so the sweep emits one callback per step). ``metrics=True`` traces the
+    phase-tagged telemetry callback in exactly as in ``text2image`` —
+    ``obs.device.instrument`` collects it; disabled, the program is
+    unchanged. Returns ``(images (G,B,H,W,3) uint8, final latents)``.
     """
     cfg = pipe.config
     if layout is None:
@@ -145,9 +151,14 @@ def sweep(
         progress_mod.activate(schedule.timesteps.shape[0],
                               f"sweep x{context.shape[0]}")
 
-    return _sweep_jit(pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
-                      scheduler, context, latents, controllers, gs,
-                      uncond_per_step, progress=progress, gate=gate_step)
+    from ..obs.spans import span
+
+    with span("sampler.sweep", groups=int(context.shape[0]),
+              steps=int(schedule.timesteps.shape[0]), gate=int(gate_step)):
+        return _sweep_jit(pipe.unet_params, pipe.vae_params, cfg, layout,
+                          schedule, scheduler, context, latents, controllers,
+                          gs, uncond_per_step, progress=progress,
+                          gate=gate_step, metrics=metrics)
 
 
 def artifact_replay_inputs(pipe, x_t, uncond_embeddings, source: str,

@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
+from ..obs import metrics as obs_metrics
 from .queue import Entry
 
 BUCKET_SIZES = (1, 2, 4, 8)
@@ -68,6 +69,15 @@ class DynamicBatcher:
         self.max_wait_ms = float(max_wait_ms)
         self._waiting: Dict[Tuple, List[Entry]] = {}
         self._oldest_ms: Dict[Tuple, float] = {}
+        reg = obs_metrics.registry()
+        # Flush cause tells the latency ⇄ occupancy story: mostly "full"
+        # means traffic saturates max_batch; mostly "age" means max_wait_ms
+        # is the binding constraint (docs/OBSERVABILITY.md).
+        self._m_flush = reg.counter(
+            "serve_batch_flushes_total", "batcher flushes by cause",
+            labels=("cause",))
+        self._m_waiting = reg.gauge(
+            "serve_batcher_waiting", "entries held in batcher buckets")
 
     def __len__(self) -> int:
         return sum(len(v) for v in self._waiting.values())
@@ -78,6 +88,7 @@ class DynamicBatcher:
         if not group:
             self._oldest_ms[key] = now_ms
         group.append(entry)
+        self._m_waiting.set(len(self))
 
     def next_flush_ms(self) -> Optional[float]:
         """Earliest future time a waiting bucket ages out (None when empty).
@@ -95,6 +106,7 @@ class DynamicBatcher:
         else:
             del self._waiting[key]
             del self._oldest_ms[key]
+        self._m_waiting.set(len(self))
         return Batch(batch_key=key, entries=taken, created_ms=now_ms)
 
     def ready(self, now_ms: float) -> List[Batch]:
@@ -104,9 +116,11 @@ class DynamicBatcher:
             while key in self._waiting and \
                     len(self._waiting[key]) >= self.max_batch:
                 out.append(self._pop(key, self.max_batch, now_ms))
+                self._m_flush.labels(cause="full").inc()
             if key in self._waiting and \
                     now_ms - self._oldest_ms[key] >= self.max_wait_ms:
                 out.append(self._pop(key, self.max_batch, now_ms))
+                self._m_flush.labels(cause="age").inc()
         out.sort(key=lambda b: min(e.seq for e in b.entries))
         return out
 
@@ -116,5 +130,6 @@ class DynamicBatcher:
         for key in list(self._waiting):
             while key in self._waiting:
                 out.append(self._pop(key, self.max_batch, now_ms))
+                self._m_flush.labels(cause="drain").inc()
         out.sort(key=lambda b: min(e.seq for e in b.entries))
         return out

@@ -24,6 +24,15 @@ Every submitted request resolves to exactly ONE structured record:
 
 A final ``summary`` record aggregates the run: counts per status, batch
 count, mean occupancy, program-cache stats, latency percentiles.
+
+The loop also feeds the telemetry registry (``p2p_tpu.obs``): request
+counters by status, reject kinds, stage-latency histograms, batch
+occupancy, bucket upsizing, and ``serve.batch``/``serve.prewarm``/
+``serve.isolate_retry`` spans — the registry is the cross-run Prometheus/
+JSONL surface (``p2p-tpu serve --metrics-out/--events-out``), while the
+record stream above stays the stable per-request contract; the summary's
+p50/p95 (raw lists) and the registry histograms must agree within one
+bucket (tests/test_obs.py pins this reconciliation).
 """
 
 from __future__ import annotations
@@ -31,6 +40,8 @@ from __future__ import annotations
 import time
 from typing import Callable, Iterable, Iterator, List, Optional
 
+from ..obs import metrics as obs_metrics
+from ..obs.spans import span
 from . import queue as queue_mod
 from .batcher import BUCKET_SIZES, Batch, DynamicBatcher, bucket_for
 from .programs import ProgramCache, default_runner_factory
@@ -145,6 +156,41 @@ def serve_forever(
     vnow = 0.0
     batch_index = 0
 
+    # Registry-backed aggregation alongside (never instead of) the JSONL
+    # records: the per-request record schema is the stable contract, the
+    # registry is the cross-run timeline (docs/OBSERVABILITY.md). Stage
+    # histograms bound memory — the summary still computes its percentiles
+    # from the raw latency list, and the test contract is that the two
+    # agree within one histogram bucket.
+    reg = obs_metrics.registry()
+    m_requests = reg.counter("serve_requests_total",
+                             "terminal per-request records by status",
+                             labels=("status",))
+    m_rejects = reg.counter("serve_admission_rejects_total",
+                            "admission rejections by kind", labels=("kind",))
+    m_stage = {
+        "queue_wait_ms": reg.histogram(
+            "serve_queue_wait_ms", "arrival -> dispatch wait per request"),
+        "compile_ms": reg.histogram(
+            "serve_compile_ms",
+            "in-band build time of the request's batch (0 on cache hit; "
+            "observed once per ok lane, like the record field — sum over "
+            "a batch overcounts by its occupancy)"),
+        "run_ms": reg.histogram(
+            "serve_run_ms", "batch execution wall time per request"),
+        "total_ms": reg.histogram(
+            "serve_request_total_ms", "arrival -> images latency"),
+    }
+    m_occupancy = reg.histogram(
+        "serve_batch_occupancy", "real lanes per dispatched batch",
+        buckets=tuple(float(b) for b in BUCKET_SIZES))
+    m_upsized = reg.counter(
+        "serve_bucket_upsized_total",
+        "batches padded up to a larger warm bucket (warm-preference)")
+    m_isolated = reg.counter(
+        "serve_isolation_retries_total",
+        "lanes re-run alone after a poisoned batch")
+
     def record(status: str, request_id: str, *, release: bool = True,
                **fields) -> dict:
         # release=False for admission rejections: a rejected submission was
@@ -152,6 +198,11 @@ def serve_forever(
         # request (duplicate-id rejection) whose capacity slot and cancel
         # marker must survive.
         counts[status] += 1
+        m_requests.labels(status=status).inc()
+        if status == "ok":
+            for key, hist in m_stage.items():
+                if key in fields:
+                    hist.observe(float(fields[key]))
         if release:
             queue.release(request_id)
         return {"request_id": request_id, "status": status, **fields}
@@ -165,19 +216,21 @@ def serve_forever(
 
     if prewarm:
         t0 = timer()
-        for req in prewarm:
-            try:
-                prep = prepare(req, pipe)
-            except ValueError:
-                # Prewarm is an optimization: an invalid spec here must not
-                # take the server down — the same request gets its proper
-                # 'rejected' record if/when it arrives in the trace.
-                continue
-            bucket = bucket_for(max_batch, max_batch)
-            entry = queue_mod.Entry(prepared=prep, arrival_ms=0.0)
-            cache.get((prep.compile_key, bucket),
-                      lambda p=prep, b=bucket, e=entry: _build(
-                          make_runner, p.compile_key, b, [e]))
+        with span("serve.prewarm"):
+            for req in prewarm:
+                try:
+                    prep = prepare(req, pipe)
+                except ValueError:
+                    # Prewarm is an optimization: an invalid spec here must
+                    # not take the server down — the same request gets its
+                    # proper 'rejected' record if/when it arrives in the
+                    # trace.
+                    continue
+                bucket = bucket_for(max_batch, max_batch)
+                entry = queue_mod.Entry(prepared=prep, arrival_ms=0.0)
+                cache.get((prep.compile_key, bucket),
+                          lambda p=prep, b=bucket, e=entry: _build(
+                              make_runner, p.compile_key, b, [e]))
         prewarm_ms = (timer() - t0) * 1000.0
 
     def run_entries(entries, compile_key, guidance, bucket):
@@ -224,11 +277,15 @@ def serve_forever(
         guidance = live[0].request.guidance
         compile_key = live[0].prepared.compile_key
         bucket = _pick_bucket(len(live), compile_key, max_batch, cache)
+        if bucket > bucket_for(len(live), max_batch):
+            m_upsized.inc()  # warm-preference padded past the smallest fit
         dispatch_ms = vnow
         try:
             t0 = timer()
-            imgs, run_ms, hit, steps_done = run_entries(
-                live, compile_key, guidance, bucket)
+            with span("serve.batch", batch=this_batch, lanes=bucket,
+                      occupancy=len(live)):
+                imgs, run_ms, hit, steps_done = run_entries(
+                    live, compile_key, guidance, bucket)
             total_ms = (timer() - t0) * 1000.0
             compile_ms = max(0.0, total_ms - run_ms)
         except Exception as exc:  # noqa: BLE001 — isolate, then re-raise per lane
@@ -237,6 +294,11 @@ def serve_forever(
             return
         vnow += compile_ms + run_ms
         occupancies.append(len(live))
+        # Observed only on success, next to the summary's list, so the
+        # histogram and mean_batch_occupancy reconcile exactly (a poisoned
+        # batch contributes to neither — its lanes re-dispatch via
+        # isolate()).
+        m_occupancy.observe(float(len(live)))
         batch_hits.append(hit)
         lanes = lane_select(imgs, range(len(live)))
         for i, e in enumerate(live):
@@ -258,12 +320,15 @@ def serve_forever(
         nonlocal vnow, batch_index
         for e in entries:
             batch_index += 1
+            m_isolated.inc()
             bucket = _pick_bucket(1, compile_key, max_batch, cache)
             dispatch_ms = vnow
             try:
                 t0 = timer()
-                imgs, run_ms, hit, steps_done = run_entries(
-                    [e], compile_key, guidance, bucket)
+                with span("serve.isolate_retry", batch=batch_index,
+                          lanes=bucket, request=e.request_id):
+                    imgs, run_ms, hit, steps_done = run_entries(
+                        [e], compile_key, guidance, bucket)
                 compile_ms = max(0.0, (timer() - t0) * 1000.0 - run_ms)
             except Exception as exc:  # noqa: BLE001
                 vnow += (timer() - t0) * 1000.0
@@ -274,6 +339,7 @@ def serve_forever(
                 continue
             vnow += compile_ms + run_ms
             occupancies.append(1)
+            m_occupancy.observe(1.0)  # success-only, mirroring dispatch()
             batch_hits.append(hit)
             lanes = lane_select(imgs, range(1))
             latency = vnow - e.arrival_ms
@@ -301,6 +367,11 @@ def serve_forever(
                 queue.submit(prep, vnow)
             except (Rejected, ValueError) as e:
                 reason = e.reason if isinstance(e, Rejected) else str(e)
+                # Bounded-cardinality reject classification (reasons are
+                # free text): backpressure kinds come off the exception,
+                # spec validation is "invalid_spec".
+                m_rejects.labels(
+                    kind=getattr(e, "kind", "invalid_spec")).inc()
                 yield record("rejected", item.request_id, release=False,
                              arrival_ms=item.arrival_ms, reason=reason)
         # 2. Feed the batcher.

@@ -23,6 +23,7 @@ import time
 from collections import OrderedDict
 from typing import Callable, Optional, Tuple
 
+from ..obs import metrics as obs_metrics
 from ..utils.cache import ensure_persistent_cache
 
 
@@ -39,6 +40,13 @@ class ProgramCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # Mirror of the instance counters in the process registry, so the
+        # Prometheus snapshot carries cache behaviour without reaching into
+        # the cache object (instance counters stay the record/bench source).
+        self._m_events = obs_metrics.registry().counter(
+            "serve_program_cache_events_total",
+            "program-cache lookups and evictions by event",
+            labels=("event",))
 
     def __len__(self) -> int:
         return len(self._lru)
@@ -51,15 +59,23 @@ class ProgramCache:
         if key in self._lru:
             self._lru.move_to_end(key)
             self.hits += 1
+            self._m_events.labels(event="hit").inc()
             return self._lru[key], True, 0.0
         self.misses += 1
+        self._m_events.labels(event="miss").inc()
         t0 = time.perf_counter()
         runner = build()
         build_ms = (time.perf_counter() - t0) * 1000.0
+        # Per-miss build/warm wall time into compile_ms{what="program"} —
+        # the "where did this window's compile time go" decomposition.
+        from ..obs import device as obs_device
+
+        obs_device.record_compile(build_ms, what="program")
         self._lru[key] = runner
         while len(self._lru) > self.capacity:
             self._lru.popitem(last=False)
             self.evictions += 1
+            self._m_events.labels(event="evict").inc()
         return runner, False, build_ms
 
     def stats(self) -> dict:

@@ -18,15 +18,19 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional
 
+from ..obs import metrics as obs_metrics
 from .request import PreparedRequest
 
 
 class Rejected(Exception):
-    """Admission refused; ``reason`` says why (surfaced in the record)."""
+    """Admission refused; ``reason`` says why (surfaced in the record).
+    ``kind`` is the bounded-cardinality classification telemetry counts by
+    (reasons are free text and must not become label values)."""
 
-    def __init__(self, reason: str):
+    def __init__(self, reason: str, kind: str = "backpressure"):
         super().__init__(reason)
         self.reason = reason
+        self.kind = kind
 
 
 @dataclasses.dataclass
@@ -76,6 +80,21 @@ class AdmissionQueue:
         self._outstanding: Dict[str, Entry] = {}
         self._cancelled: set = set()
         self._seq = 0
+        # Registry-backed depth tracking (docs/OBSERVABILITY.md). Families
+        # are get-or-create on the process registry, so multiple queues (or
+        # serve runs) share one timeline.
+        reg = obs_metrics.registry()
+        self._m_depth = reg.gauge(
+            "serve_queue_depth", "entries waiting for the batcher")
+        self._m_outstanding = reg.gauge(
+            "serve_outstanding_requests",
+            "admitted-but-unresolved requests (the backpressure bound)")
+        self._m_admitted = reg.counter(
+            "serve_admitted_total", "requests admitted past backpressure")
+
+    def _update_gauges(self) -> None:
+        self._m_depth.set(len(self._waiting))
+        self._m_outstanding.set(len(self._outstanding))
 
     def __len__(self) -> int:
         return len(self._waiting)
@@ -87,10 +106,12 @@ class AdmissionQueue:
     def submit(self, prepared: PreparedRequest, now_ms: float) -> Entry:
         rid = prepared.request.request_id
         if rid in self._outstanding:
-            raise Rejected(f"duplicate request_id {rid!r} still in flight")
+            raise Rejected(f"duplicate request_id {rid!r} still in flight",
+                           kind="duplicate_id")
         if len(self._outstanding) >= self.capacity:
             raise Rejected(
-                f"queue full ({self.capacity} outstanding); retry later")
+                f"queue full ({self.capacity} outstanding); retry later",
+                kind="queue_full")
         self._seq += 1
         # Latency accounting starts at the request's TRACE arrival, not the
         # (possibly later) moment the single-threaded loop got around to
@@ -101,6 +122,8 @@ class AdmissionQueue:
                       seq=self._seq)
         self._waiting.append(entry)
         self._outstanding[rid] = entry
+        self._m_admitted.inc()
+        self._update_gauges()
         return entry
 
     def cancel(self, request_id: str) -> bool:
@@ -121,9 +144,11 @@ class AdmissionQueue:
         out = sorted(self._waiting,
                      key=lambda e: (-e.request.priority, e.arrival_ms, e.seq))
         self._waiting = []
+        self._update_gauges()
         return out
 
     def release(self, request_id: str) -> None:
         """Resolve one admitted request (record emitted); frees capacity."""
         self._outstanding.pop(request_id, None)
         self._cancelled.discard(request_id)
+        self._update_gauges()

@@ -13,11 +13,21 @@ unchanged.
 ``trace(logdir)`` wraps a block in a ``jax.profiler`` trace — the TPU-native
 answer to SURVEY §5's "tracing: none". The resulting directory contains an
 xplane + chrome-trace (``*.trace.json.gz``) viewable in Perfetto/TensorBoard.
+
+The same callback channel doubles as the telemetry subsystem's host-event
+path (docs/OBSERVABILITY.md): ``emit_step`` carries an optional static
+``phase`` tag and ``emit_event`` carries arbitrary traced scalars, both
+fanned out to an installable obs sink (``set_obs_sink`` — installed by
+``p2p_tpu.obs.device.instrument``) alongside the progress reporter. The
+one discipline everything here shares: with ``enabled=False`` *nothing* is
+traced into the program — the compiled XLA is bit-identical to a build
+that never imported this module.
 """
 
 from __future__ import annotations
 
 import contextlib
+import functools
 import sys
 import time
 from typing import Optional
@@ -93,21 +103,69 @@ def set_step_hook(fn) -> None:
     _step_hook = fn
 
 
-def _dispatch(step) -> None:
-    r = _active
-    if r is not None:
-        r(step)
-    h = _step_hook
-    if h is not None:
-        h(step)
+# Third sink: the telemetry collector (p2p_tpu.obs.device.StepCollector),
+# called as sink("step", step_index, phase) for step callbacks and
+# sink(tag, value, None) for generic emit_event events. Installed only for
+# the duration of an instrumented run — None costs one load + is-None test.
+_obs_sink = None
 
 
-def emit_step(enabled: bool, step) -> None:
-    """Trace-time: emit ``step`` to the active reporter from inside a jitted
-    loop. With ``enabled=False`` nothing is traced in — the compiled program
-    is identical to the silent one."""
+def set_obs_sink(fn) -> None:
+    """Install (or clear, with ``None``) the telemetry sink receiving every
+    step/event callback the compiled loops emit."""
+    global _obs_sink
+    _obs_sink = fn
+
+
+def _dispatch(step, phase=None, report=True) -> None:
+    # report=False: a metrics-only emission — the progress surfaces
+    # (rewriting-line reporter, serve step hook) must stay silent. Nothing
+    # clears _active between runs (dispatch is async; there is no reliable
+    # "last callback delivered" moment), so a stale reporter from an
+    # earlier progress run would otherwise write garbled lines during a
+    # later quiet-but-instrumented run.
+    if report:
+        r = _active
+        if r is not None:
+            r(step)
+        h = _step_hook
+        if h is not None:
+            h(step)
+    s = _obs_sink
+    if s is not None:
+        s("step", int(step), phase)
+
+
+def emit_step(enabled: bool, step, phase: Optional[str] = None,
+              report: bool = True) -> None:
+    """Trace-time: emit ``step`` to the active reporter (and the obs sink)
+    from inside a jitted loop. ``phase`` is a *static* tag naming which scan
+    emitted the step ('phase1'/'phase2' for the gated sampler, 'invert'/
+    'null_text' for inversion) — it is baked into the host callback, never
+    traced. ``report=False`` (metrics-only emission: telemetry on, progress
+    off) bypasses the reporter/step-hook surfaces and feeds only the obs
+    sink. With ``enabled=False`` nothing is traced in — the compiled
+    program is identical to the silent one."""
     if enabled:
-        jax.debug.callback(_dispatch, step, ordered=False)
+        cb = (_dispatch if (phase is None and report)
+              else functools.partial(_dispatch, phase=phase, report=report))
+        jax.debug.callback(cb, step, ordered=False)
+
+
+def _dispatch_event(tag, value) -> None:
+    s = _obs_sink
+    if s is not None:
+        s(tag, value, None)
+
+
+def emit_event(enabled: bool, tag: str, value) -> None:
+    """Trace-time: emit a generic ``(tag, value)`` host event from inside a
+    jitted program — ``tag`` static, ``value`` traced (e.g. the null-text
+    inner-iteration count). Same contract as ``emit_step``: disabled means
+    nothing is traced in."""
+    if enabled:
+        jax.debug.callback(functools.partial(_dispatch_event, tag), value,
+                           ordered=False)
 
 
 @contextlib.contextmanager

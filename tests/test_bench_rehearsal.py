@@ -34,6 +34,10 @@ EXPECTED_KEYS = {
     # dict (latency percentiles, occupancy, program-cache hit rate) so the
     # trajectory tracks serving regressions alongside raw throughput.
     "serve",
+    # Telemetry overhead (ISSUE 3): instrumented vs plain sampler wall time
+    # plus a step-event liveness count, so the BENCH schema records what
+    # the observability path costs per round.
+    "obs",
     "nullinv_s_per_image",
 }
 

@@ -168,10 +168,13 @@ fused_eps = _one_forward()
 err = float(np.abs(ref_eps.astype(np.float32) - fused_eps.astype(np.float32)).max())
 print(f"qkv-fused parity max|Δeps| = {err:.3e}", flush=True)
 if cfg is TINY:
-    # On CPU the fused projection is the same dots split after — bit-exact.
-    # (On TPU the wider contraction may tile differently, so the smoke lane
-    # is where exactness is enforced; the chip run still prints its err.)
-    assert err == 0.0, f"qkv-fused projection diverged: max|Δeps|={err}"
+    # On CPU the fused projection is the same dots split after — today this
+    # measures exactly 0.0, and the tolerance exists only so an XLA upgrade
+    # that re-tiles the wider contraction can't fail the smoke lane
+    # spuriously; 1e-6 is still ~100× below any real fusion bug. (On TPU
+    # the wider contraction may tile differently, so the smoke lane is
+    # where near-exactness is enforced; the chip run still prints its err.)
+    assert err <= 1e-6, f"qkv-fused projection diverged: max|Δeps|={err}"
 time_scan(4, "qkv-fused projections")
 unet_mod._apply_attention = orig_attn
 

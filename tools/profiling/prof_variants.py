@@ -19,6 +19,11 @@ from p2p_tpu.models import SD14, init_text_encoder, init_unet
 from p2p_tpu.models import vae as vae_mod
 from p2p_tpu.utils.tokenizer import HashWordTokenizer
 
+# Siblings insert the script dir explicitly: when a launcher runs this file
+# by absolute path from another cwd with an inherited sys.path[0], the
+# implicit script-dir entry is not guaranteed — the _bench_common import
+# must not depend on it (ADVICE round-5 finding).
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from _bench_common import require_accelerator
 
 require_accelerator()

@@ -22,6 +22,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import time
 
 # Force the deterministic CPU backend before any jax import: quality is
 # platform-independent, and the goldens are pinned on CPU (same scrub the
@@ -114,6 +115,61 @@ def _serve_parity():
     return int(d.max())
 
 
+def _obs_overhead(reps=4):
+    """(overhead_frac, bitwise_identical, step_events) for the telemetry
+    path (ISSUE 3): the same tiny sampling run with metrics enabled (step
+    callbacks traced in, host collector installed) vs disabled.
+
+    The contract this gates: enabling telemetry is numerics-neutral
+    (bitwise-identical images — callbacks are a pure side channel) and its
+    wall-clock cost stays inside a bound. Disabled-mode program identity is
+    pinned structurally by tests/test_obs.py's jaxpr check; here the
+    enabled path pays for itself. Timing discipline for a noisy shared CPU:
+    the two variants are timed *interleaved* (off/on pairs, so load drift
+    hits both sides) and each side takes its best-of-``reps`` — measured
+    ~16% on an idle host, but ~80% has been observed under a concurrently
+    running test suite, which is why the default bound is a
+    pathology-catcher, not a precision target (the bench ``obs`` block
+    records the per-round number on the round's own hardware)."""
+    import jax
+
+    from p2p_tpu.engine.sampler import text2image
+    from p2p_tpu.models import TINY
+    from p2p_tpu.obs import device as obs_device
+    from p2p_tpu.obs import metrics as obs_metrics
+    from tests.test_golden import _pipe
+
+    pipe = _pipe(TINY)
+    prompts = ["a squirrel eating a burger"]
+
+    def run(metrics):
+        img, _, _ = text2image(pipe, prompts, None, num_steps=4,
+                               rng=jax.random.PRNGKey(3), metrics=metrics)
+        return np.asarray(img)
+
+    base = run(False)   # also the compile pass for the plain program
+    obs_metrics.registry().reset()
+    with obs_device.instrument():
+        inst = run(True)  # compile pass for the instrumented program
+        identical = bool(np.array_equal(base, inst))
+        t_on, t_off = [], []
+        for _ in range(reps):
+            t_off.append(_timed(run, False))
+            t_on.append(_timed(run, True))
+    t_on, t_off = min(t_on), min(t_off)
+    snap = obs_metrics.registry().snapshot()
+    steps = sum(s["value"] for s in
+                snap.get("sampler_steps_total", {"samples": []})["samples"])
+    overhead = max(0.0, t_on / t_off - 1.0)
+    return overhead, identical, int(steps)
+
+
+def _timed(run, metrics):
+    t0 = time.perf_counter()
+    run(metrics)
+    return time.perf_counter() - t0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--only", default=None,
@@ -133,15 +189,28 @@ def main(argv=None) -> int:
                     help="max per-pixel abs diff for the serve-path parity "
                          "check (default 0: serving must be bitwise "
                          "numerics-neutral)")
+    ap.add_argument("--skip-obs", action="store_true",
+                    help="skip the telemetry-overhead check")
+    ap.add_argument("--obs-overhead", type=float, default=1.5,
+                    help="max fractional wall-clock overhead of the "
+                         "metrics-enabled sampler vs disabled (ISSUE 3 "
+                         "bound). A pathology-catcher, not a precision "
+                         "target: ~0.16 idle but ~0.8 observed on a "
+                         "contended CI host, while a real regression "
+                         "(e.g. accidentally synchronous callbacks) is "
+                         "10×+ — the bench 'obs' block records the "
+                         "trustworthy per-round number")
     args = ap.parse_args(argv)
 
     cases, golden_dir, pipe = _cases()
     only = set(args.only.split(",")) if args.only else None
     if only:
-        unknown = only - set(cases) - {"phase_gate", "serve_parity"}
+        unknown = only - set(cases) - {"phase_gate", "serve_parity",
+                                       "obs_overhead"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
-                     f"valid: {', '.join(cases)}, phase_gate, serve_parity")
+                     f"valid: {', '.join(cases)}, phase_gate, serve_parity, "
+                     f"obs_overhead")
 
     drifted = []
     for name, fn in cases.items():
@@ -181,6 +250,15 @@ def main(argv=None) -> int:
               f"{'ok' if ok else 'DRIFT'}")
         if not ok:
             drifted.append("serve_parity")
+
+    if not args.skip_obs and (only is None or "obs_overhead" in only):
+        overhead, identical, steps = _obs_overhead()
+        ok = overhead <= args.obs_overhead and identical and steps > 0
+        print(f"{'obs_overhead':16s} +{overhead * 100:.1f}% vs disabled, "
+              f"bitwise={'ok' if identical else 'DIFF'}, "
+              f"step_events={steps} {'ok' if ok else 'DRIFT'}")
+        if not ok:
+            drifted.append("obs_overhead")
 
     if drifted:
         print(f"QUALITY GATE FAILED: {', '.join(drifted)} "
