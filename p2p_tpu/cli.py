@@ -457,7 +457,8 @@ def cmd_serve(args) -> int:
 
     from .obs import metrics as obs_metrics
     from .obs import spans as obs_spans
-    from .serve import Request, parse_jsonl_line, serve_forever
+    from .serve import (DegradeConfig, FaultPlan, Journal, Request,
+                        parse_jsonl_line, serve_forever)
     from .utils.progress import trace as prof_trace
 
     # One serve run == one snapshot/event-log: reset before the pipeline
@@ -481,6 +482,27 @@ def cmd_serve(args) -> int:
         # Compile-ahead with the first request as the representative shape:
         # uniform traffic then never pays a compile in-band.
         prewarm = [r for r in items if isinstance(r, Request)][:1]
+
+    journal = Journal(args.journal) if args.journal else None
+    chaos = FaultPlan.load(args.chaos_plan) if args.chaos_plan else None
+    if chaos is not None:
+        # Two kinds are inert without their enabling flag: a drill that
+        # "passes" without ever exercising the path is worse than one that
+        # fails, so say so up front.
+        kinds = set(chaos.by_batch.values()) | set(chaos.by_request.values())
+        if "nan" in kinds and not args.validate_outputs:
+            print("warning: chaos plan injects 'nan' but --validate-outputs "
+                  "is off — the injection is inert and the validation path "
+                  "is NOT being drilled", file=sys.stderr)
+        if "hang" in kinds and args.watchdog_ms is None:
+            print("warning: chaos plan injects 'hang' but --watchdog-ms is "
+                  "unset — the hang degrades to a short stall and the "
+                  "watchdog path is NOT being drilled", file=sys.stderr)
+    degrade = None
+    if args.degrade_depth is not None:
+        degrade = DegradeConfig(depth_threshold=args.degrade_depth,
+                                window_ms=args.degrade_window_ms,
+                                min_bucket=args.degrade_min_bucket)
 
     out = open(args.results, "w") if args.results else sys.stdout
 
@@ -509,9 +531,15 @@ def cmd_serve(args) -> int:
                     pipe, items, max_batch=args.max_batch,
                     max_wait_ms=args.max_wait_ms, queue_cap=args.queue_cap,
                     program_cache_cap=args.program_cache_cap,
-                    prewarm=prewarm, progress=not args.quiet):
+                    prewarm=prewarm, progress=not args.quiet,
+                    journal=journal, chaos=chaos,
+                    watchdog_ms=args.watchdog_ms,
+                    validate_outputs=args.validate_outputs,
+                    degrade=degrade):
                 emit(rec)
     finally:
+        if journal is not None:
+            journal.close()
         if out is not sys.stdout:
             out.close()
     if args.metrics_out or args.events_out:
@@ -731,6 +759,40 @@ def build_parser() -> argparse.ArgumentParser:
                         "(serve.prewarm / serve.batch / serve.isolate_retry "
                         "start/stop events, JSONL) here after the trace "
                         "drains")
+    s.add_argument("--journal", default=None, metavar="FILE",
+                   help="crash-safe request journal (append-only JSONL WAL, "
+                        "fsync'd at batch boundaries); restarting against "
+                        "the same file replays non-terminal requests "
+                        "exactly once and dedupes already-resolved ids "
+                        "(docs/SERVING.md)")
+    s.add_argument("--chaos-plan", default=None, metavar="FILE",
+                   help="deterministic fault-injection plan (JSON, see "
+                        "p2p_tpu/serve/chaos.py; generator: tools/loadgen.py "
+                        "--fault-rate). Drill tooling — never set this in "
+                        "production")
+    s.add_argument("--watchdog-ms", type=float, default=None, metavar="MS",
+                   help="arm a wall-clock watchdog around each dispatched "
+                        "batch: a compile/execute that hangs past this "
+                        "deadline (with no step progress) becomes 'timeout' "
+                        "records and a quarantined program-cache entry "
+                        "instead of a wedged server")
+    s.add_argument("--validate-outputs", action="store_true",
+                   help="post-run finite check per lane (one jnp.isfinite "
+                        "reduction off the hot path): NaN/Inf lanes resolve "
+                        "to 'invalid_output' instead of shipping black "
+                        "images")
+    s.add_argument("--degrade-depth", type=int, default=None, metavar="N",
+                   help="enable graceful degradation: when outstanding "
+                        "work stays above N for --degrade-window-ms, the "
+                        "loop steps down (force gate='auto' -> shrink max "
+                        "bucket -> shed) before rejecting")
+    s.add_argument("--degrade-window-ms", type=float, default=2000.0,
+                   metavar="MS",
+                   help="sustained-pressure window per degradation step "
+                        "(and sustained-calm window per recovery step)")
+    s.add_argument("--degrade-min-bucket", type=int, default=2,
+                   choices=(1, 2, 4),
+                   help="floor for the level-2 max-lane-bucket shrink")
     s.set_defaults(fn=cmd_serve)
 
     c = sub.add_parser(

@@ -111,6 +111,31 @@ def lane_select(outputs, lanes):
     return [out[i] for i in lanes]
 
 
+@jax.jit
+def _lane_finite_jit(x):
+    # f32 view: bf16/f16 lanes reduce identically and uint8 is trivially
+    # finite (which is why validation runs on latents, pre-decode).
+    xf = x.astype(jnp.float32)
+    return jnp.all(jnp.isfinite(xf), axis=tuple(range(1, xf.ndim)))
+
+
+def lane_finite(outputs):
+    """Output-validation hook for the serving layer: one finite flag per
+    leading-axis lane of a ``(G, ...)`` float array (final latents of a
+    padded sweep batch).
+
+    A NaN/Inf-poisoned lane decodes to a black or garbage image that would
+    otherwise ship as a healthy ``ok`` record — this is the single reduction
+    that catches it. It is a separate tiny jitted program applied to the
+    sweep's *output*, so the sampling program itself is identical whether
+    validation runs or not (the serve layer's disabled-mode contract), and
+    the cost is one all-reduce per lane, off the denoising hot path.
+    """
+    import numpy as np
+
+    return np.asarray(_lane_finite_jit(jnp.asarray(outputs)))
+
+
 def resolve_gate(gate, num_scan_steps: int,
                  controller: Optional[Controller] = None) -> int:
     """Resolve a user-facing ``gate`` spec to a static scan-step index.

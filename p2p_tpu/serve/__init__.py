@@ -5,12 +5,19 @@ serve a single caller; this package serves *traffic*: JSONL requests ride a
 bounded admission queue, compatible requests batch by compile key (padded to
 a fixed bucket set so the program count stays bounded), compiled programs
 are cached and compiled ahead of traffic, and a single-threaded worker loop
-drains batches while emitting one structured record per request. See
-docs/SERVING.md.
+drains batches while emitting one structured record per request. The
+fault-tolerance layer — crash-safe journal + replay (``journal``), typed
+failure classification with bounded retries (``faults``), a dispatch-time
+watchdog, post-run output validation, graceful degradation under pressure,
+and the deterministic fault-injection harness (``chaos``) — rides the same
+loop and is fully off by default. See docs/SERVING.md.
 """
 
 from .batcher import BUCKET_SIZES, DynamicBatcher, bucket_for
-from .engine_loop import serve_forever
+from .chaos import FaultPlan
+from .engine_loop import DegradeConfig, serve_forever
+from .faults import InjectedFault, RetryPolicy, WatchdogTimeout, classify
+from .journal import Journal, ReplayState, replay
 from .programs import ProgramCache
 from .queue import AdmissionQueue, Rejected
 from .request import Cancel, Request, parse_jsonl_line, prepare
@@ -19,12 +26,21 @@ __all__ = [
     "AdmissionQueue",
     "BUCKET_SIZES",
     "Cancel",
+    "DegradeConfig",
     "DynamicBatcher",
+    "FaultPlan",
+    "InjectedFault",
+    "Journal",
     "ProgramCache",
     "Rejected",
+    "ReplayState",
     "Request",
+    "RetryPolicy",
+    "WatchdogTimeout",
     "bucket_for",
+    "classify",
     "parse_jsonl_line",
     "prepare",
+    "replay",
     "serve_forever",
 ]

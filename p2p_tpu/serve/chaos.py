@@ -1,0 +1,151 @@
+"""Deterministic fault injection for the serve loop.
+
+The fault-tolerance paths (typed retries, lane isolation, watchdog
+timeouts, output validation, fatal drain) are exactly the code that never
+runs in a healthy test environment — so they get a harness that *makes*
+them run, deterministically. A :class:`FaultPlan` is a seeded schedule
+mapping dispatch targets (batch indices or request ids) to fault kinds;
+the engine consults it via one hook that is ``None`` in production (the
+same discipline as the obs layer: disabled means not a single extra
+branch on data, proven by the disabled-mode parity test).
+
+Fault kinds and the path each one drills:
+
+- ``transient`` — raised before the runner executes; classified transient
+  → bounded backoff + same-batch retry. Fires **once** per target (a
+  flake), so the retry succeeds and the batch's outputs stay bitwise
+  identical to the fault-free run.
+- ``poison`` — raised whenever the victim request id is in the batch
+  (reproducible per-lane failure) → lane-isolation retry; the victim
+  resolves ``error``, survivors re-run (warm-preference keeps them in the
+  same padded program, so their outputs stay bitwise identical).
+- ``hang`` — the runner call sleeps past the watchdog deadline (wall
+  clock) → ``timeout`` terminal records + program-cache quarantine.
+- ``nan`` — the run succeeds but the victim lane's finite-flag is forced
+  false → ``invalid_output`` instead of a shipped black image.
+- ``fatal`` — classified fatal → the loop drains with terminal records
+  for everything outstanding.
+
+Plans are plain JSON (``{"by_batch": {"3": "transient"}, "by_request":
+{"r-07": "poison"}}``) so ``tools/loadgen.py`` can emit them next to a
+trace and ``p2p-tpu serve --chaos-plan`` can load them;
+:meth:`FaultPlan.generate` derives one deterministically from a seed.
+``tools/chaos_drill.py`` asserts the drill invariants end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+from typing import Dict, Optional, Sequence, Tuple
+
+KINDS = ("transient", "poison", "fatal", "hang", "nan")
+
+#: Kinds that fire once and are then spent (a flake / a single hang / one
+#: fatal). ``poison`` and ``nan`` are properties of the *request* and keep
+#: firing as long as the victim id shows up.
+_ONE_SHOT = ("transient", "hang", "fatal")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One injection decision handed to the engine at dispatch time."""
+
+    kind: str
+    target: str            # "batch:<n>" or "request:<id>"
+    rids: Tuple[str, ...]  # the victim request ids within this batch
+
+
+class FaultPlan:
+    """Seeded, explicit schedule of injected faults.
+
+    ``by_batch`` keys on the engine's dispatch counter (1-based, including
+    isolation re-dispatches — the deterministic control-flow index);
+    ``by_request`` keys on request ids. Both are consulted by
+    :meth:`take`, batch match first."""
+
+    def __init__(self, by_batch: Optional[Dict[int, str]] = None,
+                 by_request: Optional[Dict[str, str]] = None,
+                 seed: Optional[int] = None):
+        self.by_batch = {int(k): v for k, v in (by_batch or {}).items()}
+        self.by_request = dict(by_request or {})
+        self.seed = seed
+        for kind in list(self.by_batch.values()) + list(self.by_request.values()):
+            if kind not in KINDS:
+                raise ValueError(f"unknown fault kind {kind!r}; "
+                                 f"valid: {', '.join(KINDS)}")
+        self._fired: set = set()
+
+    def __len__(self) -> int:
+        return len(self.by_batch) + len(self.by_request)
+
+    def reset(self) -> None:
+        """Forget one-shot firing state (re-run the same plan)."""
+        self._fired.clear()
+
+    def take(self, batch_index: int, request_ids: Sequence[str]
+             ) -> Optional[Fault]:
+        """The fault to inject into this dispatch, or None. One-shot kinds
+        are consumed; sticky kinds (poison/nan) keep matching their id."""
+        kind = self.by_batch.get(batch_index)
+        if kind is not None:
+            key = ("batch", batch_index)
+            if kind not in _ONE_SHOT or key not in self._fired:
+                self._fired.add(key)
+                return Fault(kind, f"batch:{batch_index}",
+                             tuple(request_ids))
+        for rid in request_ids:
+            kind = self.by_request.get(rid)
+            if kind is None:
+                continue
+            key = ("request", rid)
+            if kind in _ONE_SHOT and key in self._fired:
+                continue
+            self._fired.add(key)
+            return Fault(kind, f"request:{rid}", (rid,))
+        return None
+
+    # -- (de)serialization -------------------------------------------------
+    def to_dict(self) -> dict:
+        out = {"by_batch": {str(k): v for k, v in self.by_batch.items()},
+               "by_request": dict(self.by_request)}
+        if self.seed is not None:
+            out["seed"] = self.seed
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        unknown = set(d) - {"by_batch", "by_request", "seed"}
+        if unknown:
+            raise ValueError(f"unknown fault-plan field(s) {sorted(unknown)}")
+        return cls(by_batch=d.get("by_batch"), by_request=d.get("by_request"),
+                   seed=d.get("seed"))
+
+    @classmethod
+    def load(cls, path: str) -> "FaultPlan":
+        with open(path, "r", encoding="utf-8") as f:
+            return cls.from_dict(json.load(f))
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    @classmethod
+    def generate(cls, seed: int, request_ids: Sequence[str],
+                 rate: float = 0.25,
+                 kinds: Sequence[str] = ("transient", "poison", "nan"),
+                 ) -> "FaultPlan":
+        """Deterministic request-targeted plan: each id draws a fault with
+        probability ``rate``, kind chosen uniformly from ``kinds`` — same
+        seed, same ids ⇒ byte-identical plan (the loadgen contract)."""
+        for kind in kinds:
+            if kind not in KINDS:
+                raise ValueError(f"unknown fault kind {kind!r}")
+        rng = random.Random(seed)
+        by_request = {}
+        for rid in request_ids:
+            if rng.random() < rate:
+                by_request[rid] = kinds[rng.randrange(len(kinds))]
+        return cls(by_request=by_request, seed=seed)

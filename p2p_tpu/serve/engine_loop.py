@@ -4,9 +4,10 @@ The loop runs on a *virtual clock*: trace time (request ``arrival_ms``,
 batcher age-out, deadlines) advances either to the next event (an arrival
 or a bucket aging out) or by the measured wall time of each dispatched
 batch. That makes the control flow — admission order, bucketing, deadline
-expiry, backpressure — fully deterministic for a given trace and runner,
-while latency numbers stay real measurements. A JSONL file replay, the
-bench ``serve`` rehearsal, and the tests all ride the same loop.
+expiry, backpressure, retries, degradation — fully deterministic for a
+given trace and runner, while latency numbers stay real measurements. A
+JSONL file replay, the bench ``serve`` rehearsal, the chaos drill and the
+tests all ride the same loop.
 
 Every submitted request resolves to exactly ONE structured record:
 
@@ -18,35 +19,105 @@ Every submitted request resolves to exactly ONE structured record:
 - ``rejected`` — failed validation or backpressure; ``reason`` says why.
 - ``expired`` — deadline passed before dispatch (never runs).
 - ``cancelled`` — a ``{"cancel": id}`` record landed before dispatch.
-- ``error`` — the request itself poisoned a program: its batch failed, the
-  survivors were re-run without it (isolation retry), and only this lane
-  failed again. One bad request can never take its batchmates down.
+- ``error`` — the request itself poisoned a program (its batch failed, the
+  survivors were re-run without it, and only this lane failed again), or a
+  transient fault outlived the retry budget, or the loop drained after a
+  fatal fault. One bad request can never take its batchmates down.
+- ``timeout`` — the dispatch-time watchdog (``watchdog_ms``) killed a hung
+  compile/execute; the program-cache entry is quarantined.
+- ``invalid_output`` — the post-run finite check (``validate_outputs``)
+  found NaN/Inf in this lane's latents; the image is withheld.
+- ``shed`` — dropped under sustained overload at the deepest degradation
+  level (see below), with a reason — never a silent drop.
 
 A final ``summary`` record aggregates the run: counts per status, batch
-count, mean occupancy, program-cache stats, latency percentiles.
+count, mean occupancy, program-cache stats, latency percentiles, fault/
+retry/degradation tallies, and (when journaled) the replay outcome.
+
+Fault tolerance (``serve.faults``): a failed batch is *classified* —
+``transient`` failures re-run the same batch after bounded exponential
+backoff with deterministic jitter, charged to the virtual clock and capped
+by the lanes' own deadlines; ``poison`` takes the pre-existing lane-
+isolation retry; ``fatal`` drains the loop cleanly, resolving everything
+outstanding to ``error`` records. ``journal=`` (``serve.journal.Journal``)
+adds a crash-safe JSONL WAL — admitted / dispatched / terminal transitions,
+fsync'd at batch boundaries — whose replay on restart reconstructs the
+queue from non-terminal entries and serves each exactly once (trace ids
+already terminal are deduped, corrupt trailing records are skipped with a
+counter). ``chaos=`` (``serve.chaos.FaultPlan``) is the deterministic
+fault-injection hook, ``None`` in production. Under sustained queue
+pressure (``degrade=``), the loop degrades before it rejects: force
+``gate='auto'`` on gate-less requests, then shrink the max lane bucket,
+then shed — every transition (and its reversal) journaled and counted.
+
+With no journal, no chaos plan, no watchdog, no validation and no
+degradation, none of the above touches a single dispatch: the loop's
+control flow, compiled programs and outputs are identical to the
+pre-fault-tolerance engine (pinned by tests/test_faults.py's disabled-mode
+parity proof, the PR 3 discipline).
 
 The loop also feeds the telemetry registry (``p2p_tpu.obs``): request
 counters by status, reject kinds, stage-latency histograms, batch
-occupancy, bucket upsizing, and ``serve.batch``/``serve.prewarm``/
-``serve.isolate_retry`` spans — the registry is the cross-run Prometheus/
-JSONL surface (``p2p-tpu serve --metrics-out/--events-out``), while the
-record stream above stays the stable per-request contract; the summary's
-p50/p95 (raw lists) and the registry histograms must agree within one
-bucket (tests/test_obs.py pins this reconciliation).
+occupancy, bucket upsizing, fault/retry/shed/replay counters, and
+``serve.batch``/``serve.prewarm``/``serve.isolate_retry``/``serve.retry``/
+``serve.replay`` spans — the registry is the cross-run Prometheus/JSONL
+surface (``p2p-tpu serve --metrics-out/--events-out``), while the record
+stream above stays the stable per-request contract; the summary's p50/p95
+(raw lists) and the registry histograms must agree within one bucket
+(tests/test_obs.py pins this reconciliation).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Callable, Iterable, Iterator, List, Optional
 
 from ..obs import metrics as obs_metrics
 from ..obs.spans import span
+from . import faults as faults_mod
 from . import queue as queue_mod
 from .batcher import BUCKET_SIZES, Batch, DynamicBatcher, bucket_for
+from .faults import RetryPolicy
 from .programs import ProgramCache, default_runner_factory
 from .queue import AdmissionQueue, Rejected
 from .request import Cancel, PreparedRequest, Request, prepare
+
+#: Every terminal status a request can resolve to. Single-sourced from the
+#: WAL module: the journal is the durability contract, so the set of
+#: statuses it recognises as terminal *is* the set the loop can emit.
+from .journal import TERMINAL_STATUSES  # noqa: E402  (re-export)
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradeConfig:
+    """Graceful-degradation policy: when ``queue.outstanding`` stays above
+    ``depth_threshold`` for ``window_ms`` of *virtual* time, the loop steps
+    one level deeper (and one level back after an equally long calm spell):
+
+    - level 1 — force ``gate='auto'`` on gate-less requests at admission
+      (cheaper phase-2 sampling; approximate results beat rejections),
+    - level 2 — shrink the max lane bucket one fixed-bucket step below
+      the operator's cap, floored at ``min_bucket`` and never above the
+      cap (smaller batches, shorter head-of-line blocking under deadline
+      pressure),
+    - level 3 — shed: newly drained entries beyond the threshold resolve
+      to ``shed`` records, lowest priority and newest arrivals first."""
+
+    depth_threshold: int = 16
+    window_ms: float = 2000.0
+    min_bucket: int = 2
+
+    def __post_init__(self):
+        if self.depth_threshold < 1:
+            raise ValueError(f"depth_threshold must be >= 1, "
+                             f"got {self.depth_threshold}")
+        if self.window_ms <= 0:
+            raise ValueError(f"window_ms must be positive, "
+                             f"got {self.window_ms}")
+        if self.min_bucket not in BUCKET_SIZES:
+            raise ValueError(f"min_bucket must be one of {BUCKET_SIZES}, "
+                             f"got {self.min_bucket}")
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -113,6 +184,15 @@ def _pick_bucket(n: int, compile_key, max_batch: int,
     return smallest
 
 
+def _shrunken_bucket(max_batch: int, floor: int) -> int:
+    """One fixed bucket below ``max_batch``, floored at ``floor`` — the
+    level-2 degradation target. Degradation must never *raise* the
+    operator's cap, so a floor above ``max_batch`` clamps back to it
+    (level 2 becomes a no-op rather than a grow)."""
+    idx = BUCKET_SIZES.index(max_batch)
+    return min(max_batch, max(floor, BUCKET_SIZES[max(0, idx - 1)]))
+
+
 def serve_forever(
     pipe,
     requests: Iterable,
@@ -125,6 +205,12 @@ def serve_forever(
     progress: bool = False,
     runner_factory: Optional[Callable] = None,
     timer: Callable[[], float] = time.perf_counter,
+    journal=None,
+    chaos=None,
+    retry_policy: Optional[RetryPolicy] = None,
+    watchdog_ms: Optional[float] = None,
+    validate_outputs: bool = False,
+    degrade: Optional[DegradeConfig] = None,
 ) -> Iterator[dict]:
     """Drain ``requests`` (Request/Cancel objects or JSONL-shaped dicts,
     sorted by ``arrival_ms``) through the queue → batcher → program-cache →
@@ -136,25 +222,51 @@ def serve_forever(
     bucket) -> runner`` and ``timer`` are injection points for tests and
     rehearsal; the defaults run real ``parallel.sweep`` batches and measure
     wall time.
+
+    Fault tolerance (all off by default; see the module docstring):
+    ``journal`` (a ``serve.journal.Journal``) enables the crash-safe WAL +
+    replay; ``chaos`` (a ``serve.chaos.FaultPlan``) injects deterministic
+    faults; ``retry_policy`` bounds transient same-batch retries (defaults
+    to ``RetryPolicy()``); ``watchdog_ms`` arms a wall-clock per-batch
+    deadline past dispatch; ``validate_outputs`` runs the post-run finite
+    check per lane; ``degrade`` enables graceful degradation under
+    sustained queue pressure.
     """
     from ..engine.sampler import lane_select
     from ..utils import progress as progress_mod
 
-    make_runner = runner_factory or default_runner_factory(pipe,
-                                                           progress=progress)
+    make_runner = runner_factory or default_runner_factory(
+        pipe, progress=progress, validate=validate_outputs,
+        heartbeat=watchdog_ms is not None)
+    policy = retry_policy or RetryPolicy()
     queue = AdmissionQueue(queue_cap)
     batcher = DynamicBatcher(max_batch=max_batch, max_wait_ms=max_wait_ms)
-    cache = ProgramCache(program_cache_cap)
+    # The cache shares the loop's retry policy: transient *build* failures
+    # (prewarm and in-band misses) back off on the wall clock inside the
+    # cache; execution faults stay classified at dispatch and back off on
+    # the virtual clock. retry_call only retries transients, so poison and
+    # fatal builds still propagate to the taxonomy untouched.
+    cache = ProgramCache(program_cache_cap, retry_policy=policy)
     trace = _Trace(requests)
 
-    counts = {"ok": 0, "rejected": 0, "expired": 0, "cancelled": 0,
-              "error": 0}
+    counts = {s: 0 for s in TERMINAL_STATUSES}
+    fault_counts = {k: 0 for k in (faults_mod.TRANSIENT, faults_mod.POISON,
+                                   faults_mod.FATAL, faults_mod.TIMEOUT)}
+    retries_total = 0
+    timeouts_total = 0
+    degrade_transitions = 0
+    degrade_level = 0
+    pressure_since: Optional[float] = None
+    calm_since: Optional[float] = None
+    fatal_reason: List[Optional[str]] = [None]
     latencies: List[float] = []
     occupancies: List[int] = []
     batch_hits: List[bool] = []
     prewarm_ms = 0.0
     vnow = 0.0
     batch_index = 0
+    replayed_ids: set = set()
+    forced_gate_ids: set = set()
 
     # Registry-backed aggregation alongside (never instead of) the JSONL
     # records: the per-request record schema is the stable contract, the
@@ -190,19 +302,54 @@ def serve_forever(
     m_isolated = reg.counter(
         "serve_isolation_retries_total",
         "lanes re-run alone after a poisoned batch")
+    m_faults = reg.counter(
+        "serve_faults_total", "dispatch failures by classified kind",
+        labels=("kind",))
+    m_retries = reg.counter(
+        "serve_retries_total", "same-batch retries of transient faults")
+    m_backoff = reg.histogram(
+        "serve_retry_backoff_ms",
+        "virtual-clock backoff before each transient retry")
+    m_timeouts = reg.counter(
+        "serve_watchdog_timeouts_total",
+        "batches killed by the dispatch-time watchdog")
+    m_invalid = reg.counter(
+        "serve_invalid_output_lanes_total",
+        "lanes converted to invalid_output by the post-run finite check")
+    m_shed = reg.counter(
+        "serve_shed_total", "requests shed under sustained overload")
+    m_degrade_level = reg.gauge(
+        "serve_degrade_level", "current degradation level (0 = normal)")
+    m_degrade_trans = reg.counter(
+        "serve_degrade_transitions_total",
+        "degradation level changes by direction", labels=("direction",))
+    m_degraded_gate = reg.counter(
+        "serve_degraded_gate_total",
+        "requests force-gated to 'auto' under degradation")
+    m_replay = reg.counter(
+        "serve_replay_total", "journal replay outcomes by kind",
+        labels=("kind",))
 
     def record(status: str, request_id: str, *, release: bool = True,
-               **fields) -> dict:
+               journal_write: bool = True, **fields) -> dict:
         # release=False for admission rejections: a rejected submission was
         # never admitted, and its id may belong to a still-live earlier
         # request (duplicate-id rejection) whose capacity slot and cancel
-        # marker must survive.
+        # marker must survive. journal_write=False for the same duplicate
+        # case — a terminal WAL line for the duplicate's id would make a
+        # crash-replay drop the still-live original.
         counts[status] += 1
         m_requests.labels(status=status).inc()
         if status == "ok":
             for key, hist in m_stage.items():
                 if key in fields:
                     hist.observe(float(fields[key]))
+        if request_id in replayed_ids:
+            fields.setdefault("replayed", True)
+        if request_id in forced_gate_ids:
+            fields.setdefault("degraded_gate", True)
+        if journal is not None and journal_write:
+            journal.terminal(request_id, status, vnow)
         if release:
             queue.release(request_id)
         return {"request_id": request_id, "status": status, **fields}
@@ -213,6 +360,48 @@ def serve_forever(
         if warm is not None:
             warm(entries)
         return runner
+
+    # ------------------------------------------------------------------
+    # Journal replay: reconstruct the queue from non-terminal WAL entries
+    # (served exactly once; arrival restarts on this incarnation's clock)
+    # and dedupe the incoming trace against everything the WAL already
+    # resolved. Corrupt/duplicate WAL lines surface as counters only.
+    # ------------------------------------------------------------------
+    replay_skip: set = set()
+    replay_info: Optional[dict] = None
+    if journal is not None:
+        rs = journal.replay_state
+        replay_skip = set(rs.terminal) | set(rs.pending_ids)
+        if rs.pending or rs.terminal or rs.skipped_corrupt:
+            replay_info = {"pending": len(rs.pending),
+                           "terminal": len(rs.terminal),
+                           "skipped_corrupt": rs.skipped_corrupt,
+                           "duplicate_terminals": rs.duplicate_terminals,
+                           "deduped": 0}
+            if rs.skipped_corrupt:
+                m_replay.labels(kind="corrupt_skipped").inc(
+                    rs.skipped_corrupt)
+            if rs.duplicate_terminals:
+                m_replay.labels(kind="duplicate_terminal").inc(
+                    rs.duplicate_terminals)
+            with span("serve.replay", pending=len(rs.pending),
+                      terminal=len(rs.terminal)):
+                for d in rs.pending:
+                    try:
+                        req = Request.from_dict(d)
+                        req = dataclasses.replace(req, arrival_ms=0.0)
+                        prep = prepare(req, pipe)
+                        queue.submit(prep, 0.0)
+                        replayed_ids.add(req.request_id)
+                        m_replay.labels(kind="pending").inc()
+                    except (Rejected, ValueError) as e:
+                        rid = d.get("request_id", "?")
+                        m_rejects.labels(
+                            kind=getattr(e, "kind", "invalid_spec")).inc()
+                        yield record("rejected", rid, release=False,
+                                     reason=f"replayed request no longer "
+                                            f"admissible: {e}")
+            journal.sync()
 
     if prewarm:
         t0 = timer()
@@ -233,29 +422,113 @@ def serve_forever(
                               make_runner, p.compile_key, b, [e]))
         prewarm_ms = (timer() - t0) * 1000.0
 
-    def run_entries(entries, compile_key, guidance, bucket):
-        """Run one padded batch; returns (images, compile_ms, run_ms, hit).
-        The steps the compiled loop reports flow into per-request progress
-        via the shared step hook."""
-        runner, hit, _ = cache.get(
-            (compile_key, bucket),
-            lambda: _build(make_runner, compile_key, bucket, entries))
+    def run_entries(entries, compile_key, guidance, bucket, fault=None):
+        """Run one padded batch; returns (images, run_ms, hit, steps_done,
+        finite). The steps the compiled loop reports flow into per-request
+        progress via the shared step hook — and, when the watchdog is
+        armed, into its heartbeat (a batch still emitting steps is alive,
+        however long it takes; a hung compile emits nothing). The watchdog
+        covers the *build* too: an in-band compile miss that hangs raises
+        the same :class:`WatchdogTimeout` as a hung execution — the cache
+        insertion stays on this thread, so an abandoned build worker can
+        never mutate the LRU if it eventually wakes up."""
+        steps_seen = []
+        beats = [0]
+        if watchdog_ms is not None:
+            # Armed before the build: warm() runs the compiled loop, whose
+            # step callbacks re-arm the deadline — only a compile that
+            # emits nothing for the full window is shot.
+            progress_mod.set_watchdog_sink(
+                lambda: beats.__setitem__(0, beats[0] + 1))
+        raw_build = lambda: _build(make_runner, compile_key, bucket, entries)
+        build = (raw_build if watchdog_ms is None else
+                 lambda: faults_mod.run_with_watchdog(
+                     raw_build, watchdog_ms, heartbeat=lambda: beats[0],
+                     what="program build/warm"))
+        try:
+            runner, hit, _ = cache.get((compile_key, bucket), build)
+        finally:
+            if watchdog_ms is not None:
+                progress_mod.set_watchdog_sink(None)
         # cache.get's build_ms times only the closure; re-derive compile_ms
         # from our own timer so injected timers see it too.
         t0 = timer()
-        steps_seen = []
         if progress:
             progress_mod.set_step_hook(lambda s: steps_seen.append(int(s)))
+        if watchdog_ms is not None:
+            progress_mod.set_watchdog_sink(
+                lambda: beats.__setitem__(0, beats[0] + 1))
+
+        def call():
+            if fault is not None:
+                if fault.kind == "hang":
+                    # Chaos hang: block well past the watchdog deadline
+                    # (wall clock — exactly what a wedged device looks
+                    # like); without a watchdog it is a stall, then runs.
+                    time.sleep((watchdog_ms * 3 / 1000.0)
+                               if watchdog_ms else 0.05)
+                elif fault.kind in (faults_mod.TRANSIENT, faults_mod.POISON,
+                                    faults_mod.FATAL):
+                    raise faults_mod.InjectedFault(fault.kind, fault.target)
+            return runner(entries, guidance)
+
         try:
-            imgs = runner(entries, guidance)
+            if watchdog_ms is not None:
+                imgs = faults_mod.run_with_watchdog(
+                    call, watchdog_ms, heartbeat=lambda: beats[0])
+            else:
+                imgs = call()
         finally:
             if progress:
                 progress_mod.set_step_hook(None)
+            if watchdog_ms is not None:
+                progress_mod.set_watchdog_sink(None)
         run_ms = (timer() - t0) * 1000.0
-        return imgs, run_ms, hit, (max(steps_seen) + 1 if steps_seen else None)
+        finite = (getattr(runner, "last_lane_finite", None)
+                  if validate_outputs else None)
+        return imgs, run_ms, hit, (
+            max(steps_seen) + 1 if steps_seen else None), finite
+
+    def _fault_verdict(exc):
+        """Classify one dispatch failure and do the bookkeeping half of
+        the verdict (taxonomy counters); returns ``(kind, reason)``.
+        Shared by the primary dispatch and the isolation re-run so the
+        two paths cannot drift."""
+        kind = faults_mod.classify(exc)
+        fault_counts[kind] += 1
+        m_faults.labels(kind=kind).inc()
+        return kind, f"{type(exc).__name__}: {exc}"
+
+    def _note_timeout(compile_key, bucket):
+        """Watchdog-timeout bookkeeping: the program handle is suspect, so
+        quarantine it; the next miss rebuilds instead of reusing a
+        possibly-wedged executable. Shared by both dispatch paths."""
+        nonlocal timeouts_total
+        timeouts_total += 1
+        m_timeouts.inc()
+        cache.quarantine((compile_key, bucket))
+
+    def _live_after_backoff(entries):
+        """Split entries into (records to yield, survivors) after vnow
+        moved: a backoff must never outspend a lane's own deadline."""
+        recs, still = [], []
+        for e in entries:
+            if queue.is_cancelled(e.request_id):
+                recs.append(record("cancelled", e.request_id,
+                                   arrival_ms=e.arrival_ms,
+                                   queue_wait_ms=vnow - e.arrival_ms))
+            elif queue_mod.expired(e, vnow):
+                recs.append(record(
+                    "expired", e.request_id, arrival_ms=e.arrival_ms,
+                    reason=(f"deadline {e.request.deadline_ms}ms passed "
+                            f"during transient backoff (waited "
+                            f"{vnow - e.arrival_ms:.1f}ms)")))
+            else:
+                still.append(e)
+        return recs, still
 
     def dispatch(batch: Batch) -> Iterator[dict]:
-        nonlocal vnow, batch_index
+        nonlocal vnow, batch_index, retries_total
         live = []
         for e in batch.entries:
             if queue.is_cancelled(e.request_id):
@@ -276,22 +549,77 @@ def serve_forever(
         this_batch = batch_index
         guidance = live[0].request.guidance
         compile_key = live[0].prepared.compile_key
-        bucket = _pick_bucket(len(live), compile_key, max_batch, cache)
-        if bucket > bucket_for(len(live), max_batch):
+        bucket = _pick_bucket(len(live), compile_key, batcher.max_batch,
+                              cache)
+        if bucket > bucket_for(len(live), batcher.max_batch):
             m_upsized.inc()  # warm-preference padded past the smallest fit
+        if journal is not None:
+            journal.dispatched([e.request_id for e in live], this_batch,
+                               vnow)
         dispatch_ms = vnow
-        try:
+        attempt = 0
+        while True:
+            fault = (chaos.take(this_batch, [e.request_id for e in live])
+                     if chaos is not None else None)
             t0 = timer()
-            with span("serve.batch", batch=this_batch, lanes=bucket,
-                      occupancy=len(live)):
-                imgs, run_ms, hit, steps_done = run_entries(
-                    live, compile_key, guidance, bucket)
-            total_ms = (timer() - t0) * 1000.0
-            compile_ms = max(0.0, total_ms - run_ms)
-        except Exception as exc:  # noqa: BLE001 — isolate, then re-raise per lane
-            vnow += (timer() - t0) * 1000.0
-            yield from isolate(live, compile_key, guidance, exc)
-            return
+            try:
+                span_name = "serve.batch" if attempt == 0 else "serve.retry"
+                with span(span_name, batch=this_batch, lanes=bucket,
+                          occupancy=len(live),
+                          **({"attempt": attempt} if attempt else {})):
+                    imgs, run_ms, hit, steps_done, finite = run_entries(
+                        live, compile_key, guidance, bucket, fault=fault)
+                total_ms = (timer() - t0) * 1000.0
+                compile_ms = max(0.0, total_ms - run_ms)
+                break
+            except Exception as exc:  # noqa: BLE001 — classified below
+                vnow += (timer() - t0) * 1000.0
+                kind, reason = _fault_verdict(exc)
+                if kind == faults_mod.TIMEOUT:
+                    # A hung compile/execute: terminal records instead of a
+                    # wedged server.
+                    _note_timeout(compile_key, bucket)
+                    for e in live:
+                        yield record("timeout", e.request_id,
+                                     arrival_ms=e.arrival_ms, reason=reason,
+                                     batch_id=this_batch)
+                    return
+                if kind == faults_mod.FATAL:
+                    for e in live:
+                        yield record("error", e.request_id,
+                                     arrival_ms=e.arrival_ms,
+                                     reason=f"fatal: {reason}",
+                                     batch_id=this_batch)
+                    fatal_reason[0] = reason
+                    return
+                if kind == faults_mod.TRANSIENT:
+                    if attempt + 1 < policy.max_attempts:
+                        backoff = policy.backoff_ms(
+                            attempt, key=f"batch:{this_batch}")
+                        retries_total += 1
+                        m_retries.inc()
+                        m_backoff.observe(backoff)
+                        vnow += backoff
+                        attempt += 1
+                        # The backoff budget is each lane's deadline:
+                        # entries it outspent expire now instead of
+                        # burning further attempts.
+                        recs, live = _live_after_backoff(live)
+                        yield from recs
+                        if not live:
+                            return
+                        continue
+                    for e in live:
+                        yield record(
+                            "error", e.request_id, arrival_ms=e.arrival_ms,
+                            reason=(f"transient fault persisted through "
+                                    f"{policy.max_attempts} attempts: "
+                                    f"{reason}"),
+                            batch_id=this_batch)
+                    return
+                # poison: the pre-existing lane-isolation path.
+                yield from isolate(live, compile_key, guidance, exc)
+                return
         vnow += compile_ms + run_ms
         occupancies.append(len(live))
         # Observed only on success, next to the summary's list, so the
@@ -300,8 +628,26 @@ def serve_forever(
         # isolate()).
         m_occupancy.observe(float(len(live)))
         batch_hits.append(hit)
+        bad = set()
+        if finite is not None:
+            bad = {i for i in range(len(live)) if not bool(finite[i])}
+        if (fault is not None and fault.kind == "nan" and validate_outputs):
+            # Injected NaN: force the victim lanes' finite flags false —
+            # the same conversion a real NaN-poisoned latent triggers.
+            bad |= {i for i, e in enumerate(live)
+                    if e.request_id in fault.rids}
         lanes = lane_select(imgs, range(len(live)))
         for i, e in enumerate(live):
+            if i in bad:
+                m_invalid.inc()
+                yield record(
+                    "invalid_output", e.request_id,
+                    arrival_ms=e.arrival_ms,
+                    reason="non-finite values (NaN/Inf) in this lane's "
+                           "latents; image withheld",
+                    batch_id=this_batch, batch_lanes=bucket,
+                    batch_occupancy=len(live))
+                continue
             latency = vnow - e.arrival_ms
             latencies.append(latency)
             yield record(
@@ -316,31 +662,70 @@ def serve_forever(
 
     def isolate(entries, compile_key, guidance, batch_exc) -> Iterator[dict]:
         """A batch failed: re-run each lane alone so one poisoned request
-        fails alone; survivors still get served (one retry each)."""
+        fails alone; survivors still get served (one retry each). The
+        survivors ride the warm larger bucket when available
+        (warm-preference), which keeps their outputs bitwise-identical to
+        the fault-free batch (padding invariance)."""
         nonlocal vnow, batch_index
-        for e in entries:
+        entries = list(entries)
+        for idx, e in enumerate(entries):
             batch_index += 1
             m_isolated.inc()
-            bucket = _pick_bucket(1, compile_key, max_batch, cache)
+            bucket = _pick_bucket(1, compile_key, batcher.max_batch, cache)
+            if journal is not None:
+                journal.dispatched([e.request_id], batch_index, vnow)
             dispatch_ms = vnow
+            fault = (chaos.take(batch_index, [e.request_id])
+                     if chaos is not None else None)
             try:
                 t0 = timer()
                 with span("serve.isolate_retry", batch=batch_index,
                           lanes=bucket, request=e.request_id):
-                    imgs, run_ms, hit, steps_done = run_entries(
-                        [e], compile_key, guidance, bucket)
+                    imgs, run_ms, hit, steps_done, finite = run_entries(
+                        [e], compile_key, guidance, bucket, fault=fault)
                 compile_ms = max(0.0, (timer() - t0) * 1000.0 - run_ms)
-            except Exception as exc:  # noqa: BLE001
+            except Exception as exc:  # noqa: BLE001 — classified below
                 vnow += (timer() - t0) * 1000.0
+                kind, reason = _fault_verdict(exc)
+                batch_err = f"{type(batch_exc).__name__}: {batch_exc}"
+                if kind == faults_mod.TIMEOUT:
+                    # Same verdict as a hung primary dispatch.
+                    _note_timeout(compile_key, bucket)
+                    yield record(
+                        "timeout", e.request_id, arrival_ms=e.arrival_ms,
+                        reason=reason, batch_id=batch_index,
+                        batch_error=batch_err, isolated_retry=True)
+                    continue
+                if kind == faults_mod.FATAL:
+                    # Fatal during isolation fails the remaining lanes too
+                    # (they would all hit the same wall) and drains the
+                    # loop, exactly like the primary-dispatch path.
+                    fatal_reason[0] = reason
+                    for rest in entries[idx:]:
+                        yield record(
+                            "error", rest.request_id,
+                            arrival_ms=rest.arrival_ms,
+                            reason=f"fatal: {reason}", batch_error=batch_err)
+                    return
                 yield record(
                     "error", e.request_id, arrival_ms=e.arrival_ms,
-                    reason=f"{type(exc).__name__}: {exc}",
-                    batch_error=f"{type(batch_exc).__name__}: {batch_exc}")
+                    reason=reason, batch_error=batch_err)
                 continue
             vnow += compile_ms + run_ms
             occupancies.append(1)
             m_occupancy.observe(1.0)  # success-only, mirroring dispatch()
             batch_hits.append(hit)
+            if ((finite is not None and not bool(finite[0])) or
+                    (fault is not None and fault.kind == "nan"
+                     and validate_outputs)):
+                m_invalid.inc()
+                yield record(
+                    "invalid_output", e.request_id, arrival_ms=e.arrival_ms,
+                    reason="non-finite values (NaN/Inf) in this lane's "
+                           "latents; image withheld",
+                    batch_id=batch_index, batch_lanes=bucket,
+                    batch_occupancy=1, isolated_retry=True)
+                continue
             lanes = lane_select(imgs, range(1))
             latency = vnow - e.arrival_ms
             latencies.append(latency)
@@ -354,6 +739,53 @@ def serve_forever(
                 gate_step=e.prepared.gate_step,
                 **({"steps_done": steps_done} if steps_done else {}))
 
+    def update_degradation() -> None:
+        """Pressure hysteresis: one level up per sustained-pressure window,
+        one level down per sustained-calm window. Both directions are
+        journaled and counted."""
+        nonlocal degrade_level, pressure_since, calm_since, \
+            degrade_transitions
+        if degrade is None:
+            return
+        depth = queue.outstanding
+        if depth > degrade.depth_threshold:
+            calm_since = None
+            if pressure_since is None:
+                pressure_since = vnow
+            elif (vnow - pressure_since >= degrade.window_ms
+                  and degrade_level < 3):
+                degrade_level += 1
+                pressure_since = vnow  # re-arm toward the next level
+                degrade_transitions += 1
+                m_degrade_trans.labels(direction="up").inc()
+                m_degrade_level.set(degrade_level)
+                if journal is not None:
+                    journal.event("degrade", level=degrade_level,
+                                  depth=depth, vnow_ms=round(vnow, 3))
+                _apply_degrade_level()
+        else:
+            pressure_since = None
+            if calm_since is None:
+                calm_since = vnow
+            elif (vnow - calm_since >= degrade.window_ms
+                  and degrade_level > 0):
+                degrade_level -= 1
+                calm_since = vnow
+                degrade_transitions += 1
+                m_degrade_trans.labels(direction="down").inc()
+                m_degrade_level.set(degrade_level)
+                if journal is not None:
+                    journal.event("restore", level=degrade_level,
+                                  depth=depth, vnow_ms=round(vnow, 3))
+                _apply_degrade_level()
+
+    def _apply_degrade_level() -> None:
+        # Level 2+: smaller flush/padding bucket — shorter head-of-line
+        # blocking when deadlines are the binding constraint. The batcher
+        # cap stays within BUCKET_SIZES, preserving the padding contract.
+        batcher.max_batch = (_shrunken_bucket(max_batch, degrade.min_bucket)
+                             if degrade_level >= 2 else max_batch)
+
     while True:
         # 1. Admit everything that has arrived by now.
         while trace.peek() is not None and \
@@ -362,24 +794,65 @@ def serve_forever(
             if isinstance(item, Cancel):
                 queue.cancel(item.request_id)  # unknown id: benign no-op
                 continue
+            if item.request_id in replay_skip:
+                # The WAL already resolved (or re-admitted) this id:
+                # exactly-once means the trace copy is a no-op, counted.
+                m_replay.labels(kind="deduped").inc()
+                if replay_info is not None:
+                    replay_info["deduped"] += 1
+                continue
+            forced_gate = degrade_level >= 1 and item.gate is None
+            if forced_gate:
+                # Level 1+: cheaper phase-2 sampling instead of rejections
+                # — approximate results are the graceful part.
+                item = dataclasses.replace(item, gate="auto")
             try:
                 prep = prepare(item, pipe)
                 queue.submit(prep, vnow)
+                if forced_gate:
+                    # Counted only on successful admission: a rejected
+                    # request was never force-gated, it never ran.
+                    forced_gate_ids.add(item.request_id)
+                    m_degraded_gate.inc()
+                if journal is not None:
+                    journal.admitted(item.to_dict(), vnow)
             except (Rejected, ValueError) as e:
                 reason = e.reason if isinstance(e, Rejected) else str(e)
                 # Bounded-cardinality reject classification (reasons are
                 # free text): backpressure kinds come off the exception,
                 # spec validation is "invalid_spec".
-                m_rejects.labels(
-                    kind=getattr(e, "kind", "invalid_spec")).inc()
+                kind = getattr(e, "kind", "invalid_spec")
+                m_rejects.labels(kind=kind).inc()
                 yield record("rejected", item.request_id, release=False,
+                             journal_write=(kind != "duplicate_id"),
                              arrival_ms=item.arrival_ms, reason=reason)
-        # 2. Feed the batcher.
-        for entry in queue.drain():
-            batcher.add(entry, vnow)
+        update_degradation()
+        # 2. Feed the batcher — at level 3, shedding what the threshold
+        # cannot hold (lowest priority first, newest arrivals first).
+        drained = queue.drain()
+        victims: set = set()
+        if degrade is not None and degrade_level >= 3:
+            overshoot = queue.outstanding - degrade.depth_threshold
+            if overshoot > 0:
+                by_value = sorted(
+                    drained, key=lambda e: (e.request.priority, -e.seq))
+                victims = {id(e) for e in by_value[:overshoot]}
+        for entry in drained:
+            if id(entry) in victims:
+                m_shed.inc()
+                yield record(
+                    "shed", entry.request_id, arrival_ms=entry.arrival_ms,
+                    reason=(f"load shed at degradation level "
+                            f"{degrade_level}: outstanding "
+                            f"{queue.outstanding} > threshold "
+                            f"{degrade.depth_threshold}"))
+            else:
+                batcher.add(entry, vnow)
         # 3. Flush whatever is due.
         batches = batcher.ready(vnow)
         if not batches:
+            if journal is not None:
+                journal.sync()  # going idle: everything admitted is durable
             events = [t for t in (trace.next_arrival_ms,
                                   batcher.next_flush_ms()) if t is not None]
             if events:
@@ -388,12 +861,47 @@ def serve_forever(
             batches = batcher.flush_all(vnow)  # trace done: drain the tail
             if not batches:
                 break
-        for batch in batches:
+        for bi, batch in enumerate(batches):
             yield from dispatch(batch)
+            if fatal_reason[0] is not None:
+                # Fatal fault: drain cleanly — terminal records for every
+                # outstanding request, then the summary. Nothing is left
+                # wedged; a journaled restart re-serves what never ran.
+                leftover = [e for b in batches[bi + 1:] for e in b.entries]
+                leftover += [e for b in batcher.flush_all(vnow)
+                             for e in b.entries]
+                leftover += queue.drain()
+                for e in leftover:
+                    yield record(
+                        "error", e.request_id, arrival_ms=e.arrival_ms,
+                        reason=f"drained after fatal fault: "
+                               f"{fatal_reason[0]}")
+                # The trace tail too: requests that had not yet *arrived*
+                # still belong to this run's exactly-once contract — they
+                # resolve here (never admitted, so no slot to release)
+                # rather than silently vanishing with the loop.
+                while trace.peek() is not None:
+                    item = trace.pop()
+                    if (isinstance(item, Cancel)
+                            or item.request_id in replay_skip):
+                        continue
+                    yield record(
+                        "error", item.request_id, release=False,
+                        arrival_ms=item.arrival_ms,
+                        reason=f"drained after fatal fault: "
+                               f"{fatal_reason[0]}")
+                if journal is not None:
+                    journal.event("fatal", reason=fatal_reason[0],
+                                  vnow_ms=round(vnow, 3))
+                break
+        if journal is not None:
+            journal.sync()  # batch boundary: the fsync point
+        if fatal_reason[0] is not None:
+            break
 
     n_batches = len(occupancies)
     lat_sorted = sorted(latencies)
-    yield {
+    summary = {
         "request_id": None, "status": "summary",
         "counts": dict(counts),
         "n_batches": n_batches,
@@ -406,4 +914,15 @@ def serve_forever(
         "p50_ms": _percentile(lat_sorted, 50),
         "p95_ms": _percentile(lat_sorted, 95),
         "makespan_ms": vnow,
+        "faults": dict(fault_counts),
+        "retries": retries_total,
+        "watchdog_timeouts": timeouts_total,
+        "degrade_transitions": degrade_transitions,
     }
+    if replay_info is not None:
+        summary["replay"] = replay_info
+    if fatal_reason[0] is not None:
+        summary["fatal"] = fatal_reason[0]
+    if journal is not None:
+        journal.sync()
+    yield summary

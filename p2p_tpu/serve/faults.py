@@ -1,0 +1,220 @@
+"""Typed failure taxonomy + retry policy for the serving layer.
+
+Before this module, the serve loop had exactly one failure behavior: any
+batch exception triggered the lane-isolation retry. Production failure
+modes are not one kind — a transient device error (RESOURCE_EXHAUSTED, a
+busy interconnect, an injected flake) deserves the *same* batch again after
+a short backoff; a poisoned request must fail alone without taking its
+batchmates down (the pre-existing isolation path); a fatal condition (shape
+mismatch against the checkpoint, a corrupted program) will fail every batch
+forever and the only honest move is to drain the loop with terminal records
+for everything outstanding.
+
+:func:`classify` maps an exception to one of the three kinds by type and
+message pattern — unknown exceptions default to ``poison`` so the
+pre-existing isolation semantics are the fallback, never a behavior change.
+:class:`RetryPolicy` is bounded exponential backoff with *deterministic*
+jitter (a hash of the retry key and attempt index — no RNG state, so a
+replayed trace retries on the identical schedule). The engine charges
+backoffs to its virtual clock; :func:`retry_call` is the wall-clock variant
+wrapping one-shot host work (checkpoint loading, ``ProgramCache`` builds).
+
+:func:`run_with_watchdog` runs a callable in a daemon worker thread and
+bounds it with a *wall-clock* deadline — the only place the serving layer
+uses real threads. The virtual clock cannot see a hung compile or device
+execution (nothing returns to advance it), so past dispatch the watchdog is
+the liveness backstop: on expiry the caller gets :class:`WatchdogTimeout`
+(classified ``timeout``) and the worker is abandoned. An optional
+``heartbeat`` callable (wired to the compiled loop's step callbacks via
+``utils.progress.set_watchdog_sink``) re-arms the deadline while steps are
+still flowing, so a long-but-alive batch is never shot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+TRANSIENT = "transient"
+POISON = "poison"
+FATAL = "fatal"
+TIMEOUT = "timeout"
+
+#: Message fragments (lowercased) that mark a transient, retry-worthy
+#: failure — the device-runtime vocabulary for "try again later".
+_TRANSIENT_PATTERNS = (
+    "resource_exhausted", "resource exhausted", "device busy", "deadline_exceeded",
+    "unavailable", "connection reset", "temporarily", "out of memory",
+    "injected transient",
+)
+
+#: Fragments that mark a fatal, will-never-succeed failure: the program or
+#: its inputs are structurally wrong (checkpoint/shape drift), so retrying
+#: any batch is wasted work and the loop must drain. Deliberately narrow:
+#: INVALID_ARGUMENT is *not* here — the runtime raises it for per-input
+#: problems too, and misreading one poisoned request as fatal would drain
+#: the whole server where isolation would have served every survivor.
+_FATAL_PATTERNS = (
+    "shape mismatch", "checkpoint", "failed_precondition",
+    "unimplemented", "injected fatal",
+)
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by the chaos harness (``serve.chaos``); carries its
+    intended classification so drills exercise exactly the path they name."""
+
+    def __init__(self, kind: str, target: str = ""):
+        super().__init__(f"injected {kind} fault"
+                         + (f" ({target})" if target else ""))
+        self.kind = kind
+        self.target = target
+
+
+class WatchdogTimeout(RuntimeError):
+    """Raised by :func:`run_with_watchdog` when the wall-clock deadline
+    passes with no result and no heartbeat progress."""
+
+    def __init__(self, timeout_ms: float, what: str = "batch execution"):
+        super().__init__(f"{what} exceeded the {timeout_ms:.0f}ms watchdog "
+                         "deadline")
+        self.timeout_ms = timeout_ms
+
+
+class FatalFault(RuntimeError):
+    """Wrapper the engine uses to carry a fatal classification upward."""
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception to ``transient`` / ``poison`` / ``fatal`` /
+    ``timeout``.
+
+    Order matters: explicit marker types first (injected faults, watchdog),
+    then message patterns, then the ``poison`` default — which is exactly
+    the pre-fault-taxonomy behavior (lane isolation), so an exception this
+    table has never seen degrades to the old, safe path rather than a new
+    one."""
+    if isinstance(exc, WatchdogTimeout):
+        return TIMEOUT
+    if isinstance(exc, InjectedFault):
+        return exc.kind if exc.kind in (TRANSIENT, POISON, FATAL) else POISON
+    if isinstance(exc, FatalFault):
+        return FATAL
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    for pat in _FATAL_PATTERNS:
+        if pat in msg:
+            return FATAL
+    for pat in _TRANSIENT_PATTERNS:
+        if pat in msg:
+            return TRANSIENT
+    return POISON
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with deterministic jitter.
+
+    ``max_attempts`` counts *runs*, not retries: 3 means one initial try
+    plus two retries. The jitter is a pure function of ``(key, attempt)`` —
+    a blake2b hash scaled into ``[0, jitter_frac]`` of the base delay — so
+    two runs of the same trace back off on the identical schedule (the
+    chaos drill's determinism contract) while distinct batches still
+    de-synchronize."""
+
+    max_attempts: int = 3
+    base_ms: float = 50.0
+    multiplier: float = 2.0
+    max_backoff_ms: float = 2000.0
+    jitter_frac: float = 0.25
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, "
+                             f"got {self.max_attempts}")
+
+    def backoff_ms(self, attempt: int, key: str = "") -> float:
+        """Delay before retry number ``attempt`` (0 = first retry)."""
+        base = min(self.max_backoff_ms,
+                   self.base_ms * (self.multiplier ** attempt))
+        digest = hashlib.blake2b(f"{key}:{attempt}".encode(),
+                                 digest_size=8).digest()
+        frac = int.from_bytes(digest, "big") / float(2 ** 64)
+        return base * (1.0 + self.jitter_frac * frac)
+
+
+def retry_call(fn: Callable, *, policy: Optional[RetryPolicy] = None,
+               key: str = "", sleep: Callable[[float], None] = time.sleep,
+               on_retry: Optional[Callable[[int, float, BaseException],
+                                           None]] = None):
+    """Run ``fn()`` under ``policy``, retrying transient failures with
+    wall-clock backoff. Non-transient failures propagate immediately; the
+    last transient failure propagates once attempts are exhausted.
+
+    This is the one-shot host-work wrapper (checkpoint loading, program
+    builds); the engine loop implements the same policy inline because its
+    backoffs are charged to the *virtual* clock."""
+    policy = policy or RetryPolicy()
+    for attempt in range(policy.max_attempts):
+        try:
+            return fn()
+        except Exception as exc:  # noqa: BLE001 — classified, not swallowed
+            if classify(exc) != TRANSIENT or attempt + 1 >= policy.max_attempts:
+                raise
+            delay_ms = policy.backoff_ms(attempt, key)
+            if on_retry is not None:
+                on_retry(attempt, delay_ms, exc)
+            sleep(delay_ms / 1000.0)
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+def run_with_watchdog(fn: Callable[[], object], timeout_ms: float,
+                      heartbeat: Optional[Callable[[], int]] = None,
+                      what: str = "batch execution",
+                      poll_ms: float = 10.0):
+    """Run ``fn()`` in a daemon thread; raise :class:`WatchdogTimeout` if no
+    result lands within ``timeout_ms`` of wall time *and* ``heartbeat()``
+    (a monotonic progress counter, e.g. compiled-loop step callbacks) has
+    not advanced — progress re-arms the deadline. On timeout the worker is
+    abandoned (a hung XLA execution cannot be interrupted from Python); its
+    eventual result, if any, is discarded.
+
+    Known limitation: an abandoned worker that later *resumes* still emits
+    step callbacks through whatever heartbeat sink is globally installed at
+    that moment. The engine clears its sink between batches, so stale beats
+    while the loop is idle are no-ops — but beats landing during a later
+    batch's run can re-arm *that* batch's watchdog, so a second consecutive
+    hang may take longer than ``timeout_ms`` to detect."""
+    if timeout_ms <= 0:
+        raise ValueError(f"watchdog timeout must be positive, got {timeout_ms}")
+    result: list = []
+    error: list = []
+    done = threading.Event()
+
+    def work():
+        try:
+            result.append(fn())
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            error.append(e)
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=work, daemon=True,
+                              name="p2p-serve-watchdog-worker")
+    worker.start()
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    last_beat = heartbeat() if heartbeat is not None else None
+    while not done.wait(min(poll_ms / 1000.0, timeout_ms / 1000.0)):
+        if heartbeat is not None:
+            beat = heartbeat()
+            if beat != last_beat:
+                last_beat = beat
+                deadline = time.monotonic() + timeout_ms / 1000.0
+                continue
+        if time.monotonic() >= deadline:
+            raise WatchdogTimeout(timeout_ms, what)
+    if error:
+        raise error[0]
+    return result[0]

@@ -1,0 +1,168 @@
+"""Crash-safe request journal: an append-only JSONL write-ahead log.
+
+A process crash mid-trace must not lose in-flight work. The engine loop
+writes one JSON line per request-state transition —
+
+- ``admitted``   — the full request dict, at admission (before any compute)
+- ``dispatched`` — the request ids of a batch, when it is handed to a runner
+- ``terminal``   — request id + final status, when the record is emitted
+- ``event``      — loop-level transitions (degradation level changes)
+
+— buffered in userspace and :meth:`Journal.sync`'d (flush + ``os.fsync``)
+at batch boundaries, so the fsync cost is paid once per dispatch, not once
+per line. On restart, :func:`replay` folds the log into a
+:class:`ReplayState`: requests admitted but with no terminal record are the
+reconstructed queue (served exactly once by the restarted loop); requests
+with a terminal record are never re-run (their ids are deduped out of the
+incoming trace). A torn tail — the crash happened mid-``write`` — shows up
+as a truncated or garbage line: the reader *skips* it and counts it
+(``skipped_corrupt``); corruption is telemetry, never a crash. Duplicate
+terminal lines (a crash between the terminal append and the fsync can
+replay one) collapse to the first and are counted too.
+
+Delivery semantics: a terminal line is appended when the record is emitted
+to the caller, so a crash exactly between compute and emission re-runs that
+request (at-least-once compute); a crash after the terminal line treats it
+as delivered (outputs are not stored in the WAL — images are the caller's
+to persist). Request *state* is exactly-once; see docs/SERVING.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional
+
+ADMITTED = "admitted"
+DISPATCHED = "dispatched"
+TERMINAL = "terminal"
+EVENT = "event"
+
+#: Statuses that end a request's life; anything else in a ``terminal``
+#: record is skipped as corrupt (a half-written status string).
+TERMINAL_STATUSES = ("ok", "rejected", "expired", "timeout", "error",
+                     "invalid_output", "cancelled", "shed")
+
+
+@dataclasses.dataclass
+class ReplayState:
+    """What a WAL says about a previous incarnation of the loop."""
+
+    pending: List[dict] = dataclasses.field(default_factory=list)
+    terminal: Dict[str, str] = dataclasses.field(default_factory=dict)
+    skipped_corrupt: int = 0
+    duplicate_terminals: int = 0
+
+    @property
+    def pending_ids(self):
+        return [d["request_id"] for d in self.pending]
+
+
+def replay(path: str) -> ReplayState:
+    """Fold the WAL at ``path`` into a :class:`ReplayState`. Missing file =
+    empty state. Corrupt lines (torn tail, garbage bytes, wrong shapes) are
+    skipped and counted — the reader must survive anything a crash can
+    leave behind."""
+    state = ReplayState()
+    if not os.path.exists(path):
+        return state
+    admitted: Dict[str, dict] = {}
+    order: List[str] = []
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                state.skipped_corrupt += 1
+                continue
+            if not isinstance(rec, dict):
+                state.skipped_corrupt += 1
+                continue
+            kind = rec.get("type")
+            if kind == ADMITTED:
+                req = rec.get("request")
+                rid = isinstance(req, dict) and req.get("request_id")
+                if not rid:
+                    state.skipped_corrupt += 1
+                    continue
+                if rid not in admitted:  # first admission wins
+                    admitted[rid] = req
+                    order.append(rid)
+            elif kind == TERMINAL:
+                rid = rec.get("id")
+                status = rec.get("status")
+                if not rid or status not in TERMINAL_STATUSES:
+                    state.skipped_corrupt += 1
+                    continue
+                if rid in state.terminal:
+                    state.duplicate_terminals += 1
+                else:
+                    state.terminal[rid] = status
+            elif kind in (DISPATCHED, EVENT):
+                pass  # informational; replay keys off admitted/terminal
+            else:
+                state.skipped_corrupt += 1
+    state.pending = [admitted[rid] for rid in order
+                     if rid not in state.terminal]
+    return state
+
+
+class Journal:
+    """Append handle + the replay state of whatever the file already held.
+
+    Opening reads the existing log first (:func:`replay`), then appends —
+    one file is both the previous incarnation's evidence and the current
+    one's WAL, so a chain of crashes keeps folding into one history."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.replay_state = replay(path)
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+        self._dirty = False
+
+    # -- writers ----------------------------------------------------------
+    def _append(self, rec: dict) -> None:
+        self._f.write(json.dumps(rec) + "\n")
+        self._dirty = True
+
+    def admitted(self, request_dict: dict, vnow: float) -> None:
+        self._append({"type": ADMITTED, "request": request_dict,
+                      "vnow_ms": round(vnow, 3)})
+
+    def dispatched(self, request_ids, batch_index: int, vnow: float) -> None:
+        self._append({"type": DISPATCHED, "ids": list(request_ids),
+                      "batch": batch_index, "vnow_ms": round(vnow, 3)})
+
+    def terminal(self, request_id: str, status: str, vnow: float) -> None:
+        self._append({"type": TERMINAL, "id": request_id, "status": status,
+                      "vnow_ms": round(vnow, 3)})
+
+    def event(self, kind: str, **fields) -> None:
+        self._append({"type": EVENT, "kind": kind, **fields})
+
+    def sync(self) -> None:
+        """Flush + fsync — called at batch boundaries, not per line."""
+        if not self._dirty:
+            return
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._dirty = False
+
+    def close(self) -> None:
+        try:
+            self.sync()
+        finally:
+            self._f.close()
+
+    def __enter__(self) -> "Journal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

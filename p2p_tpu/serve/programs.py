@@ -28,18 +28,34 @@ from ..utils.cache import ensure_persistent_cache
 
 
 class ProgramCache:
-    """LRU over built runners, keyed by ``(compile_key, bucket)``."""
+    """LRU over built runners, keyed by ``(compile_key, bucket)``.
 
-    def __init__(self, capacity: int = 8):
+    ``retry_policy`` (a ``serve.faults.RetryPolicy``) wraps the build
+    closure on miss: a *transient* build failure (device busy mid-compile,
+    RESOURCE_EXHAUSTED) backs off on the wall clock and re-tries; poison/
+    fatal failures propagate immediately. The serve engine passes its own
+    policy here so prewarm and in-band compile misses share it — execution
+    faults are still classified at dispatch and back off on the engine's
+    *virtual* clock instead.
+
+    :meth:`quarantine` handles the watchdog path: a program whose execution
+    timed out is evicted and counted — the hang may have been the device,
+    not the program, so a later miss is allowed to rebuild it, but never to
+    reuse the possibly-wedged handle."""
+
+    def __init__(self, capacity: int = 8, retry_policy=None):
         if capacity < 1:
             raise ValueError(f"program cache capacity must be >= 1, "
                              f"got {capacity}")
         ensure_persistent_cache()
         self.capacity = capacity
+        self.retry_policy = retry_policy
         self._lru: "OrderedDict[Tuple, object]" = OrderedDict()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.quarantined = 0
+        self.build_retries = 0
         # Mirror of the instance counters in the process registry, so the
         # Prometheus snapshot carries cache behaviour without reaching into
         # the cache object (instance counters stay the record/bench source).
@@ -64,7 +80,17 @@ class ProgramCache:
         self.misses += 1
         self._m_events.labels(event="miss").inc()
         t0 = time.perf_counter()
-        runner = build()
+        if self.retry_policy is not None:
+            from .faults import retry_call
+
+            def _count_retry(attempt, delay_ms, exc):
+                self.build_retries += 1
+                self._m_events.labels(event="build_retry").inc()
+
+            runner = retry_call(build, policy=self.retry_policy,
+                                key=f"build:{key}", on_retry=_count_retry)
+        else:
+            runner = build()
         build_ms = (time.perf_counter() - t0) * 1000.0
         # Per-miss build/warm wall time into compile_ms{what="program"} —
         # the "where did this window's compile time go" decomposition.
@@ -78,10 +104,22 @@ class ProgramCache:
             self._m_events.labels(event="evict").inc()
         return runner, False, build_ms
 
+    def quarantine(self, key: Tuple) -> bool:
+        """Drop a suspect program (its execution timed out). Returns whether
+        the key was held. Quarantine ≠ eviction in the stats: an eviction is
+        capacity pressure, a quarantine is a health verdict."""
+        held = self._lru.pop(key, None) is not None
+        if held:
+            self.quarantined += 1
+            self._m_events.labels(event="quarantine").inc()
+        return held
+
     def stats(self) -> dict:
         total = self.hits + self.misses
         return {"hits": self.hits, "misses": self.misses,
                 "evictions": self.evictions, "size": len(self._lru),
+                "quarantined": self.quarantined,
+                "build_retries": self.build_retries,
                 "hit_rate": (self.hits / total) if total else 0.0}
 
 
@@ -93,15 +131,31 @@ class SweepRunner:
     prompt-batch size, latents drawn as ``normal(PRNGKey(seed))`` — so a
     lane's output is bitwise-identical to the direct path's for the same
     request (the quality-gate ``serve_parity`` contract).
+
+    ``validate=True`` additionally reduces the final latents to one finite
+    flag per lane (``engine.sampler.lane_finite`` — a separate tiny jitted
+    program on the sweep's *output*, so the sweep program itself is
+    untouched) and exposes it as ``last_lane_finite``; the engine converts
+    non-finite lanes into ``invalid_output`` records instead of shipping
+    the black images a NaN latent decodes to.
     """
 
     def __init__(self, pipe, compile_key: Tuple, bucket: int,
-                 progress: bool = False):
+                 progress: bool = False, validate: bool = False,
+                 heartbeat: bool = False):
         self.pipe = pipe
         (_, self.steps, self.scheduler, self.gate_step, self.group_batch,
          _) = compile_key
         self.bucket = bucket
         self.progress = progress
+        self.validate = validate
+        # heartbeat=True traces the step callback in even when progress is
+        # off (sweep's metrics flag: report=False, so nothing prints) —
+        # the watchdog's liveness source must not depend on the operator
+        # wanting progress lines (`--quiet --watchdog-ms` would otherwise
+        # shoot every slow-but-alive in-band compile).
+        self.heartbeat = heartbeat
+        self.last_lane_finite = None
 
     def _inputs(self, entries, zeros: bool = False):
         import jax
@@ -140,28 +194,36 @@ class SweepRunner:
         import numpy as np
 
         ctx, lat, ctrl = self._inputs(entries, zeros=True)
-        np.asarray(self._run(ctx, lat, ctrl, guidance=1.0))
+        imgs, _ = self._run(ctx, lat, ctrl, guidance=1.0)
+        np.asarray(imgs)
 
     def _run(self, ctx, lat, ctrl, guidance: float):
         from ..parallel import sweep
 
-        imgs, _ = sweep(self.pipe, ctx, lat, ctrl, num_steps=self.steps,
-                        guidance_scale=guidance, scheduler=self.scheduler,
-                        mesh=None, gate=self.gate_step,
-                        progress=self.progress)
-        return imgs
+        imgs, lats = sweep(self.pipe, ctx, lat, ctrl, num_steps=self.steps,
+                           guidance_scale=guidance, scheduler=self.scheduler,
+                           mesh=None, gate=self.gate_step,
+                           progress=self.progress, metrics=self.heartbeat)
+        return imgs, lats
 
     def __call__(self, entries, guidance: float):
         import numpy as np
 
         ctx, lat, ctrl = self._inputs(entries)
-        return np.asarray(self._run(ctx, lat, ctrl, guidance))
+        imgs, lats = self._run(ctx, lat, ctrl, guidance)
+        if self.validate:
+            from ..engine.sampler import lane_finite
+
+            self.last_lane_finite = lane_finite(lats)
+        return np.asarray(imgs)
 
 
-def default_runner_factory(pipe, progress: bool = False):
+def default_runner_factory(pipe, progress: bool = False,
+                           validate: bool = False, heartbeat: bool = False):
     """The engine's default ``runner_factory``: real sweeps on ``pipe``."""
 
     def make(compile_key: Tuple, bucket: int) -> SweepRunner:
-        return SweepRunner(pipe, compile_key, bucket, progress=progress)
+        return SweepRunner(pipe, compile_key, bucket, progress=progress,
+                           validate=validate, heartbeat=heartbeat)
 
     return make

@@ -117,6 +117,21 @@ def set_obs_sink(fn) -> None:
     _obs_sink = fn
 
 
+# Fourth sink: the serve watchdog's heartbeat (p2p_tpu.serve.faults).
+# Called with no arguments on every step callback, regardless of the
+# report flag — a compiled loop still emitting steps is alive, however
+# slow, so the dispatch-time watchdog re-arms instead of shooting it; a
+# hung compile/execute emits nothing and the deadline stands.
+_watchdog_sink = None
+
+
+def set_watchdog_sink(fn) -> None:
+    """Install (or clear, with ``None``) a zero-arg callable invoked on
+    every step callback — the serve watchdog's liveness heartbeat."""
+    global _watchdog_sink
+    _watchdog_sink = fn
+
+
 def _dispatch(step, phase=None, report=True) -> None:
     # report=False: a metrics-only emission — the progress surfaces
     # (rewriting-line reporter, serve step hook) must stay silent. Nothing
@@ -134,6 +149,9 @@ def _dispatch(step, phase=None, report=True) -> None:
     s = _obs_sink
     if s is not None:
         s("step", int(step), phase)
+    w = _watchdog_sink
+    if w is not None:
+        w()
 
 
 def emit_step(enabled: bool, step, phase: Optional[str] = None,

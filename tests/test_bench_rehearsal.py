@@ -38,6 +38,10 @@ EXPECTED_KEYS = {
     # plus a step-event liveness count, so the BENCH schema records what
     # the observability path costs per round.
     "obs",
+    # Resilience drill (ISSUE 4): retry/shed/replay counts and the p95
+    # delta the fault-tolerance machinery adds under the standard seeded
+    # fault plan, so the trajectory tracks what robustness costs.
+    "resilience",
     "nullinv_s_per_image",
 }
 
@@ -487,6 +491,17 @@ def test_bench_rehearsal_green_and_complete():
     assert doc["serve"]["mean_batch_occupancy"] >= 2.0
     assert doc["serve"]["program_cache_hit_rate"] >= 0.9
     assert doc["serve"]["p95_ms"] > 0
+    # Resilience acceptance (ISSUE 4): the standard drill must actually
+    # drill — faults fired and were retried, ok outputs stayed bitwise-
+    # stable vs the fault-free run (run_drill raises otherwise, failing
+    # the rehearsal), and the crash-replay found real pending work in the
+    # WAL with zero corrupt records on a clean kill.
+    res = doc["resilience"]
+    assert res["faults_fired"] >= 1
+    assert res["retries"] >= 1
+    assert res["bitwise_compared"] >= 1
+    assert res["replayed_pending"] >= 1
+    assert res["replay_skipped_corrupt"] == 0
 
 def test_onchip_provenance_survives_binary_corrupt_artifact(
         tmp_path, monkeypatch):

@@ -28,6 +28,20 @@ import jax.experimental.pallas.tpu as pltpu
 
 from p2p_tpu.models import nn
 
+# jax 0.4.37 ships neither `pltpu.force_tpu_interpret_mode` nor a working
+# fallback: monkeypatching `pallas_call(interpret=True)` trips an
+# interpreter bug in masked-load discharge (pl.load with a mask fails to
+# lower), so the interpret-mode parity tests cannot run on this jax at
+# any price short of vendoring the interpreter. xfail(strict=False), not
+# skip: the moment a jax upgrade restores the API these run again and the
+# xfail shows up as XPASS.
+interpret_mode_broken = pytest.mark.xfail(
+    not hasattr(pltpu, "force_tpu_interpret_mode"),
+    reason="jax 0.4.37: pltpu.force_tpu_interpret_mode missing and the "
+           "pallas interpreter's masked-load discharge is broken; "
+           "real-TPU kernel coverage is unaffected",
+    strict=False, raises=AttributeError)
+
 
 def _ref(q, k, v, scale):
     probs = nn.attention_probs(q, k, scale).astype(v.dtype)
@@ -41,6 +55,7 @@ def _rand_qkv(seed, b, h, s, d, dtype):
 
 
 @pytest.mark.slow
+@interpret_mode_broken
 def test_flash_interpret_parity_f32_sd_shape():
     s, d = 4096, 40  # the 64²-pixel SD-1.4 site
     blk = nn.flash_block(s, d, 4)
@@ -55,6 +70,7 @@ def test_flash_interpret_parity_f32_sd_shape():
 
 
 @pytest.mark.slow
+@interpret_mode_broken
 def test_flash_interpret_parity_bf16_sd_shape():
     # The production dtype on TPU: bf16 tensors, f32 softmax accumulation.
     s, d = 4096, 40
@@ -69,6 +85,7 @@ def test_flash_interpret_parity_bf16_sd_shape():
                                np.asarray(want), atol=4e-2, rtol=4e-2)
 
 
+@interpret_mode_broken
 def test_flash_interpret_parity_small_multiblock():
     # Fast case: S=512 with block 256 → a 2×2 block grid, several heads —
     # exercises the cross-block online-softmax reassociation cheaply.
@@ -83,6 +100,7 @@ def test_flash_interpret_parity_small_multiblock():
                                atol=1e-5, rtol=1e-5)
 
 
+@interpret_mode_broken
 def test_flash_interpret_parity_vae_head_geometry():
     # The VAE decoder's mid-block attention runs the kernel with a single
     # 512-wide head in f32 (models/vae.py) — the widest-head site in the
@@ -99,6 +117,7 @@ def test_flash_interpret_parity_vae_head_geometry():
                                atol=1e-4, rtol=1e-5)
 
 
+@interpret_mode_broken
 def test_flash_interpret_grad_matches_einsum():
     """Differentiating THROUGH the flash kernel must work and match the
     materialized-attention gradient: null-text inversion backprops through
@@ -155,6 +174,7 @@ def test_flash_block_selection():
     assert nn.flash_block(4096, 4096, 4) == 0
 
 
+@interpret_mode_broken
 def test_flash_residuals_semantics():
     # (out, l, m) from the residuals variant: out normalized, l = row sum of
     # exp(s - m), m = row max — the invariants ring attention's merge relies
@@ -176,6 +196,7 @@ def test_flash_residuals_semantics():
 
 
 @pytest.mark.slow
+@interpret_mode_broken
 def test_ring_attention_flash_chunks_parity():
     # Flash-chunked ring vs einsum-chunked ring vs single-device reference,
     # on a 4-device CPU mesh with 1024-pixel local chunks (the production
@@ -201,6 +222,7 @@ def test_ring_attention_flash_chunks_parity():
 
 
 @pytest.mark.slow
+@interpret_mode_broken
 def test_ring_attention_flash_grad_falls_back_to_einsum():
     # The flash chunk's custom VJP recomputes through the einsum block, so a
     # differentiated sequence-parallel site (e.g. inversion under SpConfig)

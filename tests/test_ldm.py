@@ -116,17 +116,20 @@ def test_ldm_e2e_text2image_with_edit(ldm_pipe):
     controller across the 32²-equivalent tiny pyramid."""
     prompts = ["a painting of a cat", "a painting of a dog"]
     ctrl = factory.attention_replace(
-        prompts, 2, cross_replace_steps=0.8, self_replace_steps=0.4,
+        prompts, 3, cross_replace_steps=0.8, self_replace_steps=0.4,
         tokenizer=ldm_pipe.tokenizer, self_max_pixels=8 * 8,
         max_len=ldm_pipe.config.text.max_length)
-    img, x_t, _ = text2image(ldm_pipe, prompts, ctrl, num_steps=2,
+    # 3 steps, not 2: at 2 steps on this host the edit-vs-baseline pixel
+    # delta lands below the VQ codebook's quantization floor and both runs
+    # decode to the same codes, so the inequality below is vacuous.
+    img, x_t, _ = text2image(ldm_pipe, prompts, ctrl, num_steps=3,
                              rng=jax.random.PRNGKey(0))
     assert img.shape == (2, 64, 64, 3)
     assert img.dtype == jnp.uint8
     assert x_t.shape[0] == 1  # shared-seed expansion
 
     # EmptyControl baseline from the same latent differs from the edited run
-    img0, _, _ = text2image(ldm_pipe, prompts, None, num_steps=2, latent=x_t)
+    img0, _, _ = text2image(ldm_pipe, prompts, None, num_steps=3, latent=x_t)
     assert not np.array_equal(np.asarray(img), np.asarray(img0))
 
 

@@ -220,6 +220,7 @@ def test_program_cache_lru_counters_and_eviction():
     assert "b" not in c and "a" in c and "c" in c
     c.get("b", lambda: "prog_b2")                    # miss again
     assert c.stats() == {"hits": 2, "misses": 4, "evictions": 2, "size": 2,
+                         "quarantined": 0, "build_retries": 0,
                          "hit_rate": pytest.approx(2 / 6)}
 
 
@@ -318,7 +319,8 @@ def test_engine_poisoned_request_fails_alone(tiny_pipe):
     assert [ids for ids in log[1:]] == [["r0"], ["r1"], ["r2"], ["r3"]]
     summary = by["summary"][0]
     assert summary["counts"] == {"ok": 3, "rejected": 0, "expired": 0,
-                                 "cancelled": 0, "error": 1}
+                                 "cancelled": 0, "error": 1, "timeout": 0,
+                                 "invalid_output": 0, "shed": 0}
 
 
 def test_engine_backpressure_rejects_overflow(tiny_pipe):
@@ -544,6 +546,48 @@ def test_cli_serve_end_to_end(tmp_path):
     assert os.path.exists(out_dir / "cli-0_y_hat.png")
     assert os.path.exists(out_dir / "cli-1.png")
     assert all("images" not in r for r in recs)  # arrays never hit JSONL
+
+
+def test_cli_serve_fault_flags_end_to_end(tmp_path):
+    """The ISSUE 4 flag set through the real CLI: a chaos plan poisons one
+    request's outputs (nan) under --validate-outputs, the WAL journals the
+    run, and a restart against the same journal dedupes every already-
+    terminal id instead of re-serving."""
+    from p2p_tpu.cli import main
+
+    trace = tmp_path / "demo.jsonl"
+    with open(trace, "w") as f:
+        f.write(json.dumps({
+            "request_id": "f-0", "prompt": "a cat", "steps": 2}) + "\n")
+        f.write(json.dumps({
+            "request_id": "f-1", "prompt": "a dog", "steps": 2}) + "\n")
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps({"by_request": {"f-1": "nan"}}))
+    results = tmp_path / "results.jsonl"
+    wal = tmp_path / "serve.wal"
+    argv = ["serve", "--quiet", "--requests", str(trace),
+            "--results", str(results), "--journal", str(wal),
+            "--chaos-plan", str(plan), "--validate-outputs",
+            "--watchdog-ms", "60000", "--max-batch", "2",
+            "--max-wait-ms", "5"]
+    assert main(argv) == 0
+    by = _by_status([json.loads(l) for l in open(results)])
+    assert [r["request_id"] for r in by["ok"]] == ["f-0"]
+    assert [r["request_id"] for r in by["invalid_output"]] == ["f-1"]
+    wal_recs = [json.loads(l) for l in open(wal)]
+    assert {r["id"] for r in wal_recs if r["type"] == "terminal"} == {
+        "f-0", "f-1"}
+
+    # Restart against the same journal: both ids are terminal in the WAL,
+    # so the trace is fully deduped — nothing re-runs, nothing is lost.
+    results2 = tmp_path / "results2.jsonl"
+    argv2 = ["serve", "--quiet", "--requests", str(trace),
+             "--results", str(results2), "--journal", str(wal),
+             "--max-batch", "2", "--max-wait-ms", "5"]
+    assert main(argv2) == 0
+    by2 = _by_status([json.loads(l) for l in open(results2)])
+    assert not by2.get("ok") and not by2.get("invalid_output")
+    assert by2["summary"][0]["replay"]["deduped"] == 2
 
 
 def test_cli_serve_rejects_malformed_trace_line(tmp_path):

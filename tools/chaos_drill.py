@@ -1,0 +1,341 @@
+"""Deterministic chaos drill for the fault-tolerant serve loop.
+
+Runs a seeded loadgen trace through ``p2p_tpu.serve.serve_forever`` twice —
+once fault-free, once under a seeded ``FaultPlan`` — and asserts the two
+drill invariants the fault-tolerance layer promises (ISSUE 4):
+
+1. **Exactly one terminal state.** Every admitted request resolves to
+   exactly one of ``ok / rejected / expired / timeout / error /
+   invalid_output / cancelled / shed`` — under any fault plan, nothing is
+   dropped and nothing is answered twice.
+2. **Bitwise-stable outputs.** Every ``ok`` record in the faulted run is
+   also ``ok`` in the fault-free run and its image is bitwise-identical:
+   retries, lane isolation and warm-bucket re-dispatch may change *when* a
+   request runs, never *what* it computes.
+
+``--crash-after K`` adds the crash-replay drill: the first run is
+abandoned after K terminal records (a simulated process death; the WAL
+keeps only what was flushed), then the loop restarts against the same
+``--journal`` file and the same trace — the invariant is that the union of
+both runs serves every request exactly once, with no completed request
+re-running.
+
+The whole drill is virtual-clock deterministic on the random-init tiny
+pipeline (no checkpoints), so it doubles as the ``fault_drill`` check in
+``tools/quality_gate.py`` and the ``resilience`` block in ``bench.py``.
+
+    python tools/chaos_drill.py                      # standard drill
+    python tools/chaos_drill.py --n 32 --fault-rate 0.4 --seed 7
+    python tools/chaos_drill.py --crash-after 8      # + crash-replay drill
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _pin_cpu():
+    """Deterministic CPU backend (same scrub as quality_gate: the drill's
+    contract is bitwise, so the platform must be pinned). Called from
+    ``main()`` only — importers like bench.py choose their own backend and
+    must not have theirs scrubbed at import time."""
+    from p2p_tpu.utils.cache import default_cache_dir
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          default_cache_dir(hash_xla_flags=False))
+
+
+class DrillFailure(AssertionError):
+    """An invariant the fault-tolerance layer promises did not hold."""
+
+
+def tiny_pipeline():
+    """Random-init TINY pipeline (the conftest fixture's standalone twin):
+    drills need determinism, not checkpoints."""
+    import jax
+
+    from p2p_tpu.engine.sampler import Pipeline
+    from p2p_tpu.models import TINY, init_text_encoder, init_unet
+    from p2p_tpu.models import vae as vae_mod
+    from p2p_tpu.utils.tokenizer import HashWordTokenizer
+
+    return Pipeline(
+        config=TINY,
+        unet_params=init_unet(jax.random.PRNGKey(0), TINY.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(1), TINY.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(2), TINY.vae),
+        tokenizer=HashWordTokenizer(model_max_length=TINY.text.max_length),
+    )
+
+
+def standard_trace(n: int = 24, seed: int = 8, steps: int = 4,
+                   fault_rate: float = 0.25, cancel_rate: float = 0.1,
+                   kinds=("transient", "poison", "nan")):
+    """(trace, FaultPlan) pair for the standard drill — all seeded, so
+    every caller (CLI, quality gate, bench) drills the identical scenario
+    for the same arguments."""
+    import importlib.util
+
+    from p2p_tpu.serve.chaos import FaultPlan
+
+    spec = importlib.util.spec_from_file_location(
+        "p2p_loadgen", os.path.join(_REPO, "tools", "loadgen.py"))
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+
+    trace = loadgen.generate_trace(n, mode="poisson", rate_per_s=50.0,
+                                   seed=seed, steps=steps)
+    plan = FaultPlan.from_dict(
+        loadgen.fault_plan_dict(trace, seed, fault_rate, kinds=kinds))
+    if cancel_rate > 0:
+        trace = loadgen.with_cancels(trace, seed, cancel_rate)
+    return trace, plan
+
+
+def _terminal_records(records):
+    from p2p_tpu.serve.engine_loop import TERMINAL_STATUSES
+
+    return [r for r in records if r.get("status") in TERMINAL_STATUSES]
+
+
+def check_exactly_once(trace, records, label: str = "drill") -> dict:
+    """Invariant 1: every admitted request id → exactly one terminal
+    record. Returns {id: record}."""
+    ids = [r["request_id"] for r in trace if "request_id" in r]
+    seen: dict = {}
+    for rec in _terminal_records(records):
+        rid = rec["request_id"]
+        if rid in seen:
+            raise DrillFailure(
+                f"{label}: request {rid!r} resolved twice "
+                f"({seen[rid]['status']} then {rec['status']})")
+        seen[rid] = rec
+    missing = [rid for rid in ids if rid not in seen]
+    if missing:
+        raise DrillFailure(f"{label}: {len(missing)} request(s) never "
+                           f"reached a terminal state: {missing[:5]}")
+    extra = set(seen) - set(ids)
+    if extra:
+        raise DrillFailure(f"{label}: terminal records for ids not in the "
+                           f"trace: {sorted(extra)[:5]}")
+    return seen
+
+
+def check_bitwise_vs_clean(clean_by_id: dict, faulted_by_id: dict) -> int:
+    """Invariant 2: every faulted-run ``ok`` is ``ok`` in the clean run
+    with a bitwise-identical image. Returns how many ids were compared."""
+    import numpy as np
+
+    compared = 0
+    for rid, rec in faulted_by_id.items():
+        if rec["status"] != "ok":
+            continue
+        clean = clean_by_id.get(rid)
+        if clean is None or clean["status"] != "ok":
+            raise DrillFailure(
+                f"request {rid!r} is ok under faults but "
+                f"{clean['status'] if clean else 'missing'} fault-free — "
+                "faults must only ever degrade, never manufacture results")
+        if not np.array_equal(np.asarray(rec["images"]),
+                              np.asarray(clean["images"])):
+            raise DrillFailure(
+                f"request {rid!r}: output under faults differs from the "
+                "fault-free run — retries/isolation changed the numerics")
+        compared += 1
+    return compared
+
+
+def run_drill(pipe, trace, plan, *, watchdog_ms=None, journal_path=None,
+              crash_after=None, serve_kw=None, warmup: bool = False) -> dict:
+    """Run the (clean, faulted[, crash-replay]) drill; raise
+    :class:`DrillFailure` on any invariant violation; return the
+    resilience summary the bench/quality-gate callers record.
+
+    ``warmup=True`` runs the clean trace once unmeasured first, so the
+    measured runs both hit warm compile caches and the reported p95 delta
+    is retry/backoff cost, not compile noise."""
+    from p2p_tpu.serve import serve_forever
+
+    kw = dict(max_batch=4, max_wait_ms=20.0, queue_cap=256,
+              validate_outputs=True)
+    kw.update(serve_kw or {})
+
+    if warmup:
+        for _ in serve_forever(pipe, list(trace), **kw):
+            pass
+    clean = list(serve_forever(pipe, list(trace), **kw))
+    clean_by_id = check_exactly_once(trace, clean, "fault-free run")
+
+    plan.reset()
+    faulted = list(serve_forever(pipe, list(trace), chaos=plan,
+                                 watchdog_ms=watchdog_ms, **kw))
+    faulted_by_id = check_exactly_once(trace, faulted, "faulted run")
+    compared = check_bitwise_vs_clean(clean_by_id, faulted_by_id)
+
+    def _counts(by_id):
+        out: dict = {}
+        for rec in by_id.values():
+            out[rec["status"]] = out.get(rec["status"], 0) + 1
+        return out
+
+    clean_summary = clean[-1]
+    faulted_summary = faulted[-1]
+    result = {
+        "n_requests": len(clean_by_id),
+        "faults_planned": len(plan),
+        "clean_counts": _counts(clean_by_id),
+        "faulted_counts": _counts(faulted_by_id),
+        "bitwise_compared": compared,
+        "retries": faulted_summary["retries"],
+        "faults": faulted_summary["faults"],
+        "watchdog_timeouts": faulted_summary["watchdog_timeouts"],
+        "shed": faulted_summary["counts"]["shed"],
+        "p95_clean_ms": clean_summary["p95_ms"],
+        "p95_faulted_ms": faulted_summary["p95_ms"],
+        "p95_delta_ms": faulted_summary["p95_ms"] - clean_summary["p95_ms"],
+    }
+
+    if crash_after is not None:
+        if journal_path is None:
+            journal_path = os.path.join(
+                tempfile.mkdtemp(prefix="p2p-chaos-"), "drill.wal")
+        result["crash_replay"] = crash_replay_drill(
+            pipe, trace, journal_path, crash_after, serve_kw=kw)
+    return result
+
+
+def crash_replay_drill(pipe, trace, journal_path, crash_after: int,
+                       serve_kw=None) -> dict:
+    """Simulated process death after ``crash_after`` terminal records,
+    then a journaled restart over the same trace. Invariant: both runs
+    together serve every request exactly once — nothing lost, nothing
+    re-answered."""
+    from p2p_tpu.serve import Journal, serve_forever
+    from p2p_tpu.serve.engine_loop import TERMINAL_STATUSES
+
+    kw = dict(serve_kw or {})
+    if os.path.exists(journal_path):
+        os.remove(journal_path)
+
+    first: list = []
+    journal = Journal(journal_path)
+    gen = serve_forever(pipe, list(trace), journal=journal, **kw)
+    for rec in gen:
+        first.append(rec)
+        if len(_terminal_records(first)) >= crash_after:
+            break
+    gen.close()
+    # Simulated crash: the loop dies here. Close the raw handle (flush,
+    # no final sync) — the WAL keeps whatever the crash left behind.
+    journal._f.close()
+
+    journal2 = Journal(journal_path)
+    replay = journal2.replay_state
+    second = list(serve_forever(pipe, list(trace), journal=journal2, **kw))
+    journal2.close()
+
+    # Strict exactly-once: a request that reached *any* terminal state
+    # before the crash must not reach one again after the restart. The one
+    # legitimate overlap is 'rejected' — duplicate-id admission rejections
+    # are deliberately never journaled (a terminal WAL line for the
+    # duplicate's id would make replay drop the still-live original).
+    seen: dict = {}
+    run2 = {r["request_id"]: r["status"] for r in _terminal_records(second)}
+    for rec in _terminal_records(first):
+        rid = rec["request_id"]
+        if rid in run2 and "rejected" not in (rec["status"], run2[rid]):
+            raise DrillFailure(
+                f"crash-replay: request {rid!r} reached a terminal state in "
+                f"both runs ({rec['status']!r}, then {run2[rid]!r})")
+        seen.setdefault(rid, rec["status"])
+    for rid, status in run2.items():
+        seen.setdefault(rid, status)
+    ids = [r["request_id"] for r in trace if "request_id" in r]
+    missing = [rid for rid in ids if rid not in seen]
+    if missing:
+        raise DrillFailure(f"crash-replay: {len(missing)} request(s) lost "
+                           f"across the crash: {missing[:5]}")
+    summary2 = second[-1]
+    return {
+        "crash_after": crash_after,
+        "replayed_pending": len(replay.pending),
+        "already_terminal": len(replay.terminal),
+        "skipped_corrupt": replay.skipped_corrupt,
+        "replay": summary2.get("replay"),
+    }
+
+
+def main(argv=None) -> int:
+    _pin_cpu()
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=24)
+    ap.add_argument("--seed", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--fault-rate", type=float, default=0.25)
+    ap.add_argument("--cancel-rate", type=float, default=0.1)
+    ap.add_argument("--fault-kinds", default="transient,poison,nan",
+                    help="comma list; add 'hang' with --watchdog-ms and "
+                         "'fatal' to drill the drain path")
+    ap.add_argument("--trace", default=None,
+                    help="drill an existing loadgen JSONL trace instead of "
+                         "generating one")
+    ap.add_argument("--plan", default=None,
+                    help="fault-plan JSON for --trace (loadgen "
+                         "--fault-rate writes it)")
+    ap.add_argument("--watchdog-ms", type=float, default=None)
+    ap.add_argument("--crash-after", type=int, default=None, metavar="K",
+                    help="also run the crash-replay drill: abandon the "
+                         "journaled run after K terminal records, restart, "
+                         "assert exactly-once across both")
+    ap.add_argument("--journal", default=None,
+                    help="WAL path for --crash-after (default: a tempdir)")
+    ap.add_argument("--warmup", action="store_true",
+                    help="one unmeasured clean pass first, so the p95 "
+                         "delta is retry cost, not compile noise")
+    args = ap.parse_args(argv)
+
+    if (args.trace is None) != (args.plan is None):
+        ap.error("--trace and --plan go together")
+    if args.trace:
+        from p2p_tpu.serve.chaos import FaultPlan
+
+        with open(args.trace) as f:
+            trace = [json.loads(l) for l in f if l.strip()]
+        plan = FaultPlan.load(args.plan)
+    else:
+        kinds = tuple(k for k in args.fault_kinds.split(",") if k)
+        trace, plan = standard_trace(args.n, args.seed, args.steps,
+                                     args.fault_rate, args.cancel_rate,
+                                     kinds)
+
+    print(f"chaos drill: {sum('request_id' in r for r in trace)} requests, "
+          f"{len(plan)} planned faults "
+          f"({json.dumps(plan.to_dict()['by_request'], sort_keys=True)})",
+          file=sys.stderr)
+    pipe = tiny_pipeline()
+    try:
+        result = run_drill(pipe, trace, plan, watchdog_ms=args.watchdog_ms,
+                           journal_path=args.journal,
+                           crash_after=args.crash_after, warmup=args.warmup)
+    except DrillFailure as e:
+        print(f"DRILL FAILED: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(result, indent=2, sort_keys=True))
+    print("drill OK: every request reached exactly one terminal state; "
+          f"{result['bitwise_compared']} ok outputs bitwise-identical to "
+          "the fault-free run", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

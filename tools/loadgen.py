@@ -20,6 +20,17 @@ step-counts instead, for cache-pressure experiments.
 
     python tools/loadgen.py --n 48 --mode poisson --rate 20 --seed 0 \
         --steps 4 --out demo.jsonl
+
+Two optional schedule sections make a trace a chaos drill
+(tools/chaos_drill.py):
+
+- ``--cancel-rate`` interleaves seeded ``{"cancel": <id>}`` markers into
+  the stream — each victim is cancelled one arrival after it was admitted,
+  so cancellation-before-dispatch is actually exercised.
+- ``--fault-rate`` emits a ``serve.chaos.FaultPlan`` JSON next to the
+  trace (``--fault-plan-out``, default ``<out>.faults.json``): each
+  request id draws a fault kind with the given probability from the same
+  seed, so trace + plan regenerate byte-identically together.
 """
 
 from __future__ import annotations
@@ -89,6 +100,47 @@ def generate_trace(
     return out
 
 
+def with_cancels(trace: List[dict], seed: int, rate: float) -> List[dict]:
+    """Interleave seeded ``{"cancel": id}`` markers: each victim (drawn
+    with probability ``rate``) is cancelled right after the *next* arrival,
+    so it is in the queue but (usually) not yet dispatched. The last
+    request has no later arrival to ride and is never a victim. Cancel
+    markers carry no ``arrival_ms`` — the serve trace parser times them by
+    stream position."""
+    import numpy as np
+
+    if not 0.0 <= rate <= 1.0:
+        raise ValueError(f"cancel rate must be in [0, 1], got {rate}")
+    rng = np.random.RandomState(seed ^ 0x5CA1AB1E)
+    out: List[dict] = []
+    pending_cancel = None
+    for req in trace:
+        out.append(req)
+        if pending_cancel is not None:
+            out.append({"cancel": pending_cancel})
+            pending_cancel = None
+        if rng.random_sample() < rate:
+            pending_cancel = req["request_id"]
+    return out
+
+
+def fault_plan_dict(trace: List[dict], seed: int, rate: float,
+                    kinds=("transient", "poison", "nan")) -> dict:
+    """A ``serve.chaos.FaultPlan`` (as its JSON dict) drawn over the
+    trace's request ids — same seed + same trace ⇒ byte-identical plan."""
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from p2p_tpu.serve.chaos import FaultPlan
+
+    rids = [r["request_id"] for r in trace if "request_id" in r]
+    return FaultPlan.generate(seed, rids, rate=rate,
+                              kinds=tuple(kinds)).to_dict()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--n", type=int, default=48)
@@ -108,6 +160,21 @@ def main(argv=None) -> int:
     ap.add_argument("--gate", default=None,
                     help="phase-gate spec stamped on every request "
                          "('auto', a fraction, or a step index)")
+    ap.add_argument("--cancel-rate", type=float, default=0.0,
+                    help="interleave seeded {'cancel': id} markers at this "
+                         "per-request probability (each victim cancelled "
+                         "one arrival after admission)")
+    ap.add_argument("--fault-rate", type=float, default=0.0,
+                    help="emit a chaos FaultPlan JSON drawing a fault per "
+                         "request id at this probability "
+                         "(see --fault-plan-out)")
+    ap.add_argument("--fault-kinds", default="transient,poison,nan",
+                    help="comma list of fault kinds the plan draws from "
+                         "(transient, poison, fatal, hang, nan)")
+    ap.add_argument("--fault-plan-out", default=None,
+                    help="where to write the FaultPlan JSON (default: "
+                         "<--out>.faults.json; required with --fault-rate "
+                         "when the trace goes to stdout)")
     ap.add_argument("--out", default=None,
                     help="write the JSONL trace here (default: stdout)")
     args = ap.parse_args(argv)
@@ -121,6 +188,21 @@ def main(argv=None) -> int:
         burst_size=args.burst_size, burst_gap_ms=args.burst_gap_ms,
         deadline_ms=args.deadline_ms, distinct_keys=args.distinct_keys,
         gate=gate)
+    if args.fault_rate > 0:
+        plan_path = args.fault_plan_out or (
+            args.out and args.out + ".faults.json")
+        if not plan_path:
+            ap.error("--fault-rate needs --fault-plan-out (or --out)")
+        plan = fault_plan_dict(trace, args.seed, args.fault_rate,
+                               kinds=[k for k in
+                                      args.fault_kinds.split(",") if k])
+        with open(plan_path, "w") as f:
+            json.dump(plan, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {plan_path} "
+              f"({len(plan['by_request'])} faulted ids)", file=sys.stderr)
+    if args.cancel_rate > 0:
+        trace = with_cancels(trace, args.seed, args.cancel_rate)
     out = open(args.out, "w") if args.out else sys.stdout
     try:
         for req in trace:

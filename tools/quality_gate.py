@@ -115,6 +115,29 @@ def _serve_parity():
     return int(d.max())
 
 
+def _fault_drill():
+    """The resilience contract (ISSUE 4), gated on the standard seeded
+    chaos drill (tools/chaos_drill.py, seed 8): a fixed loadgen trace under
+    a fixed fault plan must (1) resolve every admitted request to exactly
+    one terminal state, (2) keep every ``ok`` output bitwise-identical to
+    the fault-free run of the same trace, and (3) survive a simulated
+    crash + journaled restart with exactly-once semantics and zero corrupt
+    records. ``run_drill`` raises on (1)/(2)/the crash invariant; the
+    returned summary lets the gate also insist the drill actually *drilled*
+    (faults fired, retries happened, the replay had pending work) — a plan
+    that silently injects nothing would otherwise pass vacuously."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "p2p_chaos_drill", os.path.join(_REPO, "tools", "chaos_drill.py"))
+    drill = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(drill)
+
+    trace, plan = drill.standard_trace()
+    return drill.run_drill(drill.tiny_pipeline(), trace, plan,
+                           crash_after=8, warmup=True)
+
+
 def _obs_overhead(reps=4):
     """(overhead_frac, bitwise_identical, step_events) for the telemetry
     path (ISSUE 3): the same tiny sampling run with metrics enabled (step
@@ -191,6 +214,10 @@ def main(argv=None) -> int:
                          "numerics-neutral)")
     ap.add_argument("--skip-obs", action="store_true",
                     help="skip the telemetry-overhead check")
+    ap.add_argument("--skip-fault-drill", action="store_true",
+                    help="skip the chaos/crash-replay resilience check "
+                         "(ISSUE 4; ~35s: it serves the standard trace "
+                         "four times)")
     ap.add_argument("--obs-overhead", type=float, default=1.5,
                     help="max fractional wall-clock overhead of the "
                          "metrics-enabled sampler vs disabled (ISSUE 3 "
@@ -206,11 +233,11 @@ def main(argv=None) -> int:
     only = set(args.only.split(",")) if args.only else None
     if only:
         unknown = only - set(cases) - {"phase_gate", "serve_parity",
-                                       "obs_overhead"}
+                                       "obs_overhead", "fault_drill"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
                      f"valid: {', '.join(cases)}, phase_gate, serve_parity, "
-                     f"obs_overhead")
+                     f"obs_overhead, fault_drill")
 
     drifted = []
     for name, fn in cases.items():
@@ -259,6 +286,27 @@ def main(argv=None) -> int:
               f"step_events={steps} {'ok' if ok else 'DRIFT'}")
         if not ok:
             drifted.append("obs_overhead")
+
+    if not args.skip_fault_drill and (only is None or "fault_drill" in only):
+        try:
+            res = _fault_drill()
+        except AssertionError as e:  # DrillFailure: an invariant broke
+            print(f"{'fault_drill':16s} INVARIANT VIOLATED: {e}")
+            drifted.append("fault_drill")
+        else:
+            fired = sum(res["faults"].values())
+            replay = res["crash_replay"]
+            ok = (res["bitwise_compared"] > 0 and fired > 0
+                  and res["retries"] > 0 and replay["replayed_pending"] > 0
+                  and replay["skipped_corrupt"] == 0)
+            print(f"{'fault_drill':16s} {fired} faults fired, "
+                  f"{res['retries']} retries, "
+                  f"{res['bitwise_compared']} ok outputs bitwise-stable, "
+                  f"replay {replay['replayed_pending']} pending/"
+                  f"{replay['already_terminal']} terminal "
+                  f"{'ok' if ok else 'DRIFT'}")
+            if not ok:
+                drifted.append("fault_drill")
 
     if drifted:
         print(f"QUALITY GATE FAILED: {', '.join(drifted)} "
