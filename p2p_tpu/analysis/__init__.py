@@ -1,0 +1,30 @@
+"""jaxcheck — static analysis for the whole stack (docs/STATIC_ANALYSIS.md).
+
+Two passes, one structured report:
+
+- **Pass 1 (AST lints)** — :mod:`.astlint`: repo-specific TPU/JAX rules
+  over the package source, with inline ``# jaxcheck: disable=<rule>``
+  suppressions and a committed baseline (:mod:`.findings`). Pure Python,
+  no jax import — runs in milliseconds on every PR.
+- **Pass 2 (traced-program contracts)** — :mod:`.contracts` +
+  :mod:`.compile_key`: trace the canonical programs (text2image baseline,
+  gated phase 1/2, serve batch programs across lane buckets, inversion) on
+  a tiny pipeline and assert jaxpr-level contracts: no f64, no callbacks
+  in hot scans beyond the registered obs sinks, no CFG-doubled tensors in
+  phase 2, donation as declared, and ``compile_key`` completeness over the
+  full ``Request`` schema.
+
+Drivers: ``tools/jaxcheck.py`` (CLI, ``--fix``, ``--update-baseline``),
+``p2p-tpu check --static``, and the ``static_analysis`` check in
+``tools/quality_gate.py``.
+"""
+
+from .astlint import RULES, lint_file, lint_paths, lint_source  # noqa: F401
+from .findings import (  # noqa: F401
+    Finding,
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+    summarize,
+)
+from .report import run_all, run_ast_pass, run_contract_pass  # noqa: F401

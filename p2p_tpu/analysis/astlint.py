@@ -1,0 +1,667 @@
+"""Pass 1 — AST lints over the package source (no jax import, no tracing).
+
+A rule is ``(id, severity, docstring, checker)`` registered in
+:data:`RULES`; a checker takes a :class:`ModuleContext` and yields
+:class:`~p2p_tpu.analysis.findings.Finding`. The repo-specific rules
+encode the TPU/JAX invariants this codebase keeps re-learning in review:
+
+``traced-branch``   Python ``if``/``while`` on traced data inside a
+                    jit/scan body — tracing picks ONE side forever (or
+                    raises a ConcretizationTypeError at trace time).
+``host-sync``       ``.item()`` / ``np.asarray`` / ``float()`` on traced
+                    values inside a jit/scan body — a device sync in the
+                    hot path (or a tracer leak).
+``impure-jit``      ``time.time()`` / Python ``random`` / ``np.random``
+                    inside jitted code — baked in at trace time, silently
+                    constant across calls.
+``f64-literal``     ``jnp.float64`` dtypes — silent downcast under default
+                    x64-disabled config, 2× memory + no TPU support when
+                    someone flips x64 on.
+``mutable-default`` mutable default arguments — one shared instance across
+                    calls; in pytree dataclasses it also breaks structural
+                    equality of compile keys.
+``import-time-jax`` array-creating ``jnp``/``jax.random`` calls at module
+                    scope — forces backend init (and possibly device
+                    memory) on *import*, before the CLI can pick a
+                    platform.
+``unused-import``   dead imports (mechanical; ``--fix`` removes them).
+``shadowed-name``   a binding that silently rebinds an imported name (or a
+                    parameter that shadows a module-level import).
+
+Traced regions are found statically: functions decorated with ``jax.jit``
+(including ``partial(jax.jit, ...)``), functions passed to
+``lax.scan``/``while_loop``/``fori_loop``/``cond``/``switch``/``jax.vmap``
+/``jax.grad``/``jax.checkpoint`` (by name, through ``partial`` too), and
+every function nested inside one. This is a lint, not a proof: it
+over-approximates (a helper called from a traced body but defined at
+module level is missed) and relies on the narrow idioms this repo actually
+uses — which is exactly what makes it cheap enough to run on every PR.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from .findings import Finding, apply_suppressions
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+RULES: "Dict[str, Tuple[str, str]]" = {}     # id -> (severity, summary)
+_CHECKERS: "List[Tuple[str, object]]" = []   # (id, checker)
+
+
+def rule(rule_id: str, severity: str, summary: str):
+    """Decorator registering a checker under ``rule_id``."""
+
+    def register(fn):
+        RULES[rule_id] = (severity, summary)
+        _CHECKERS.append((rule_id, fn))
+        return fn
+
+    return register
+
+
+# ---------------------------------------------------------------------------
+# Module context: one parse, shared derived tables
+# ---------------------------------------------------------------------------
+
+_TRACE_CONSUMERS = {
+    # call roots whose function-valued argument(s) get traced
+    "scan", "while_loop", "fori_loop", "cond", "switch",
+    "vmap", "grad", "value_and_grad", "checkpoint", "remat", "jit",
+    "custom_vjp", "custom_jvp", "pmap", "shard_map",
+}
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.lax.scan' for Attribute/Name chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_partial(call: ast.Call) -> bool:
+    d = _dotted(call.func)
+    return d in ("partial", "functools.partial")
+
+
+def _fn_refs(node: ast.AST) -> Iterator[str]:
+    """Names of functions referenced by a call argument: a bare Name, or
+    the first argument of a ``partial(...)``."""
+    if isinstance(node, ast.Name):
+        yield node.id
+    elif isinstance(node, ast.Call) and _is_partial(node) and node.args:
+        yield from _fn_refs(node.args[0])
+
+
+class ModuleContext:
+    """One parsed module plus the derived tables every rule shares."""
+
+    def __init__(self, source: str, path: str):
+        self.source = source
+        self.path = path
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.is_init = os.path.basename(path) == "__init__.py"
+        # name -> import node (module-level only)
+        self.imports: Dict[str, ast.stmt] = {}
+        # names bound by `import x as x` / listed in __all__: re-exports
+        self.reexports: Set[str] = set()
+        self._collect_imports()
+        self.traced_fns = self._find_traced_functions()
+
+    # -- imports ----------------------------------------------------------
+
+    def _collect_imports(self) -> None:
+        for node in self.tree.body:
+            stmts = [node]
+            # TYPE_CHECKING imports still bind names used in annotations.
+            if isinstance(node, ast.If) and _dotted(node.test).endswith(
+                    "TYPE_CHECKING"):
+                stmts = list(node.body)
+            for stmt in stmts:
+                if isinstance(stmt, ast.Import):
+                    for a in stmt.names:
+                        name = (a.asname or a.name).split(".")[0]
+                        self.imports[name] = stmt
+                        if a.asname and a.asname == a.name:
+                            self.reexports.add(name)
+                elif isinstance(stmt, ast.ImportFrom):
+                    if stmt.module == "__future__":
+                        continue
+                    for a in stmt.names:
+                        if a.name == "*":
+                            continue
+                        name = a.asname or a.name
+                        self.imports[name] = stmt
+                        if a.asname and a.asname == a.name:
+                            self.reexports.add(name)
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "__all__"
+                            for t in node.targets)):
+                for elt in ast.walk(node.value):
+                    if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str):
+                        self.reexports.add(elt.value)
+
+    # -- traced regions ---------------------------------------------------
+
+    def _find_traced_functions(self) -> List[ast.AST]:
+        """FunctionDefs (and Lambdas) statically known to be traced."""
+        by_name: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+
+        traced: List[ast.AST] = []
+        seen: Set[int] = set()
+
+        def mark(fn: ast.AST) -> None:
+            if id(fn) in seen:
+                return
+            seen.add(id(fn))
+            traced.append(fn)
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    names = set()
+                    if isinstance(dec, ast.Call):
+                        names.add(_dotted(dec.func).rsplit(".", 1)[-1])
+                        for a in dec.args:
+                            names.add(_dotted(a).rsplit(".", 1)[-1])
+                    else:
+                        names.add(_dotted(dec).rsplit(".", 1)[-1])
+                    if names & _TRACE_CONSUMERS:
+                        mark(node)
+            elif isinstance(node, ast.Call):
+                tail = _dotted(node.func).rsplit(".", 1)[-1]
+                if tail in _TRACE_CONSUMERS:
+                    for arg in list(node.args) + [k.value
+                                                  for k in node.keywords]:
+                        for ref in _fn_refs(arg):
+                            for fn in by_name.get(ref, []):
+                                mark(fn)
+                        if isinstance(arg, ast.Lambda):
+                            mark(arg)
+
+        # Nested defs inside a traced function are traced too.
+        frontier = list(traced)
+        while frontier:
+            fn = frontier.pop()
+            for sub in ast.walk(fn):
+                if sub is not fn and isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and id(sub) not in seen:
+                    seen.add(id(sub))
+                    traced.append(sub)
+                    frontier.append(sub)
+        return traced
+
+    # -- helpers ----------------------------------------------------------
+
+    def finding(self, rule_id: str, node: ast.AST, message: str) -> Finding:
+        sev, _ = RULES[rule_id]
+        line = getattr(node, "lineno", 1)
+        text = self.lines[line - 1].strip() if line <= len(self.lines) else ""
+        return Finding(rule=rule_id, severity=sev, path=self.path,
+                       line=line, message=message, source_line=text)
+
+
+def _param_tainted(fn: ast.AST) -> Set[str]:
+    """Parameter names plus names assigned (directly) from param-derived
+    expressions — a one-pass forward taint, good enough for scan bodies."""
+    args = fn.args
+    names = {a.arg for a in (args.posonlyargs + args.args + args.kwonlyargs)}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    names.discard("self")
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for node in body:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                used = {n.id for n in ast.walk(sub.value)
+                        if isinstance(n, ast.Name)}
+                if used & names:
+                    for tgt in sub.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                names.add(n.id)
+    return names
+
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+
+
+def _static_expr(node: ast.AST) -> bool:
+    """Expressions that are static facts even about traced arrays."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(sub, ast.Call):
+            tail = _dotted(sub.func).rsplit(".", 1)[-1]
+            if tail in ("isinstance", "len", "hasattr", "getattr", "type"):
+                return True
+    return False
+
+
+def _tainted_data_leaf(node: ast.AST, tainted: Set[str]) -> bool:
+    """A Name or Subscript rooted at a tainted name (a traced value or a
+    piece of one) — excluding static-fact expressions."""
+    if _static_expr(node):
+        return False
+    root = node
+    while isinstance(root, (ast.Subscript, ast.Starred)):
+        root = root.value
+    return isinstance(root, ast.Name) and root.id in tainted
+
+
+# ---------------------------------------------------------------------------
+# Rules — traced-region hazards
+# ---------------------------------------------------------------------------
+
+
+@rule("traced-branch", "error",
+      "Python branch on traced data inside a jit/scan body")
+def _check_traced_branch(ctx: ModuleContext) -> Iterator[Finding]:
+    for fn in ctx.traced_fns:
+        tainted = _param_tainted(fn)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                    continue
+                test = node.test
+                # Bare flags (`if capture:`) and None checks are the static
+                # idioms jit code legitimately branches on.
+                if isinstance(test, ast.Name) or (
+                        isinstance(test, ast.UnaryOp)
+                        and isinstance(test.op, ast.Not)
+                        and isinstance(test.operand, ast.Name)):
+                    continue
+                if isinstance(test, ast.Constant):
+                    continue
+                if isinstance(test, ast.Compare) and any(
+                        isinstance(c, ast.Constant) and c.value is None
+                        for c in [test.left] + list(test.comparators)):
+                    continue
+                if _static_expr(test):
+                    continue
+                hot = [leaf for leaf in ast.walk(test)
+                       if isinstance(leaf, (ast.Name, ast.Subscript))
+                       and _tainted_data_leaf(leaf, tainted)]
+                # Only comparisons/arithmetic over traced data are a trap;
+                # a bare tainted name as the whole test was skipped above.
+                if hot and isinstance(test, (ast.Compare, ast.BoolOp,
+                                             ast.BinOp)):
+                    kind = ("if" if isinstance(node, (ast.If, ast.IfExp))
+                            else "while")
+                    yield ctx.finding(
+                        "traced-branch", node,
+                        f"`{kind}` on traced value(s) "
+                        f"{sorted({_leaf_name(h) for h in hot})} inside a "
+                        "traced function: tracing freezes one side (use "
+                        "lax.cond/jnp.where, or hoist to a static arg)")
+
+
+def _leaf_name(node: ast.AST) -> str:
+    while isinstance(node, (ast.Subscript, ast.Starred)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else "<expr>"
+
+
+_HOST_SYNC_METHODS = {"item", "tolist", "to_py", "block_until_ready"}
+_HOST_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+
+
+@rule("host-sync", "error",
+      "host-synchronizing call on traced data inside a jit/scan body")
+def _check_host_sync(ctx: ModuleContext) -> Iterator[Finding]:
+    for fn in ctx.traced_fns:
+        tainted = _param_tainted(fn)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _HOST_SYNC_METHODS
+                        and _tainted_data_leaf(node.func.value, tainted)):
+                    yield ctx.finding(
+                        "host-sync", node,
+                        f".{node.func.attr}() on a traced value inside a "
+                        "traced function: device sync / tracer leak")
+                    continue
+                d = _dotted(node.func)
+                if (d in _HOST_SYNC_CALLS or d in _HOST_CASTS) and node.args \
+                        and _tainted_data_leaf(node.args[0], tainted):
+                    yield ctx.finding(
+                        "host-sync", node,
+                        f"{d}() on a traced value inside a traced function: "
+                        "forces a host round-trip (keep it jnp, or move it "
+                        "outside the jit)")
+
+
+_IMPURE_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
+                    "datetime.", "os.urandom", "secrets.")
+_IMPURE_EXEMPT = {"np.random.default_rng"}  # host-side Generator *handle*
+
+
+@rule("impure-jit", "error",
+      "wall-clock / unseeded randomness inside a jit/scan body")
+def _check_impure(ctx: ModuleContext) -> Iterator[Finding]:
+    for fn in ctx.traced_fns:
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func)
+                if d in _IMPURE_EXEMPT:
+                    continue
+                if d.startswith(_IMPURE_PREFIXES):
+                    yield ctx.finding(
+                        "impure-jit", node,
+                        f"{d}() inside a traced function: evaluated ONCE at "
+                        "trace time and baked into the program (use "
+                        "jax.random with an explicit key, or hoist to the "
+                        "host)")
+
+
+# ---------------------------------------------------------------------------
+# Rules — dtype / structure hazards (whole module)
+# ---------------------------------------------------------------------------
+
+
+def _names_float64(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "float64":
+        return True
+    d = _dotted(node)
+    return d.endswith(".float64") or d == "float64"
+
+
+@rule("f64-literal", "warning",
+      "explicit float64 dtype in jnp code (promotion / x64 hazard)")
+def _check_f64(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Attribute):
+            d = _dotted(node)
+            if d in ("jnp.float64", "jax.numpy.float64"):
+                yield ctx.finding(
+                    "f64-literal", node,
+                    "jnp.float64: silently f32 under default config, 2x "
+                    "memory and unsupported on TPU under x64 (compute in "
+                    "f32/bf16; do f64 accumulation host-side with numpy)")
+        elif isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            rooted_jnp = d.startswith(("jnp.", "jax.numpy.")) or \
+                (isinstance(node.func, ast.Attribute)
+                 and node.func.attr == "astype"
+                 and not d.startswith(("np.", "numpy.")))
+            if not rooted_jnp:
+                continue
+            vals = [k.value for k in node.keywords if k.arg == "dtype"]
+            if node.func.attr == "astype" if isinstance(
+                    node.func, ast.Attribute) else False:
+                vals += list(node.args[:1])
+            for v in vals:
+                if _dotted(v) in ("jnp.float64", "jax.numpy.float64"):
+                    continue  # already reported at the Attribute site above
+                if _names_float64(v) and not _dotted(v).startswith(
+                        ("np.", "numpy.")):
+                    yield ctx.finding(
+                        "f64-literal", node,
+                        f"float64 dtype in `{d}(...)`: silent downcast "
+                        "under default x64-off config; hazard if x64 is "
+                        "ever enabled")
+
+
+_MUTABLE_CALLS = {"list", "dict", "set", "bytearray", "defaultdict",
+                  "OrderedDict", "collections.defaultdict",
+                  "collections.OrderedDict"}
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return _dotted(node.func) in _MUTABLE_CALLS
+    return False
+
+
+@rule("mutable-default", "error",
+      "mutable default argument (shared across calls; breaks pytree "
+      "dataclass key equality)")
+def _check_mutable_default(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                if _is_mutable_literal(d):
+                    name = getattr(node, "name", "<lambda>")
+                    yield ctx.finding(
+                        "mutable-default", d,
+                        f"mutable default in `{name}(...)`: one instance "
+                        "is shared across every call (use None + create "
+                        "inside, or dataclasses.field(default_factory=...))")
+        elif isinstance(node, ast.ClassDef):
+            decorated = any("dataclass" in _dotted(
+                d.func if isinstance(d, ast.Call) else d)
+                for d in node.decorator_list)
+            if not decorated:
+                continue
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                        and _is_mutable_literal(stmt.value):
+                    yield ctx.finding(
+                        "mutable-default", stmt,
+                        f"mutable default on dataclass field "
+                        f"`{getattr(stmt.target, 'id', '?')}`: shared "
+                        "across instances (use field(default_factory=...))")
+
+
+_IMPORT_TIME_ROOTS = ("jnp.", "jax.numpy.", "jax.random.")
+_IMPORT_TIME_CALLS = {"jax.devices", "jax.local_devices", "jax.device_put",
+                      "jax.device_count", "jax.local_device_count"}
+
+
+def _walk_eager(node: ast.AST):
+    """ast.walk, but skipping the interiors of lambdas and nested function
+    definitions — their bodies run at call time, not import time."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.Lambda, ast.FunctionDef,
+                              ast.AsyncFunctionDef)):
+            continue
+        yield from _walk_eager(child)
+
+
+@rule("import-time-jax", "warning",
+      "array-creating jnp/jax call at module import time")
+def _check_import_time(ctx: ModuleContext) -> Iterator[Finding]:
+    def scan(stmts) -> Iterator[Finding]:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                # Decorators run at import, bodies don't.
+                nodes: List[ast.AST] = list(stmt.decorator_list)
+                if isinstance(stmt, ast.ClassDef):
+                    yield from scan(stmt.body)  # class attrs run at import
+            elif isinstance(stmt, ast.If):
+                yield from scan(stmt.body)
+                yield from scan(stmt.orelse)
+                continue
+            else:
+                nodes = [stmt]
+            for top in nodes:
+                for node in _walk_eager(top):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    d = _dotted(node.func)
+                    if d.startswith(_IMPORT_TIME_ROOTS) or \
+                            d in _IMPORT_TIME_CALLS:
+                        yield ctx.finding(
+                            "import-time-jax", node,
+                            f"{d}() at module import time: initializes the "
+                            "backend (and may allocate device memory) "
+                            "before any CLI/platform choice runs — build "
+                            "lazily inside a function")
+
+    yield from scan(ctx.tree.body)
+
+
+# ---------------------------------------------------------------------------
+# Rules — mechanical hygiene
+# ---------------------------------------------------------------------------
+
+_WORD_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+@rule("unused-import", "warning", "imported name never used (dead import)")
+def _check_unused_import(ctx: ModuleContext) -> Iterator[Finding]:
+    if ctx.is_init:
+        return  # __init__ imports are the package's public re-export surface
+    used: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # String annotations / docstring references — conservative: a
+            # word match anywhere in a string counts as a use.
+            used |= set(_WORD_RE.findall(node.value))
+    for name, stmt in ctx.imports.items():
+        if name in used or name in ctx.reexports or name.startswith("_"):
+            continue
+        line_text = ctx.lines[stmt.lineno - 1] if stmt.lineno <= len(
+            ctx.lines) else ""
+        if "noqa" in line_text:
+            continue
+        yield ctx.finding(
+            "unused-import", stmt,
+            f"`{name}` imported but never used")
+
+
+@rule("shadowed-name", "warning",
+      "binding shadows an imported name")
+def _check_shadowed(ctx: ModuleContext) -> Iterator[Finding]:
+    if not ctx.imports:
+        return
+    import_lines = {s.lineno for s in ctx.imports.values()}
+    # Module-level rebinding of an import.
+    for stmt in ctx.tree.body:
+        targets: List[str] = []
+        if isinstance(stmt, ast.Assign):
+            # Direct Name targets only: `os.environ[k] = v` mutates through
+            # the import, it does not rebind it.
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    targets.append(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    targets.extend(n.id for n in t.elts
+                                   if isinstance(n, ast.Name))
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name) and stmt.value is not None:
+            targets.append(stmt.target.id)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            targets.append(stmt.name)
+        for name in targets:
+            imp = ctx.imports.get(name)
+            if imp is not None and stmt.lineno not in import_lines \
+                    and stmt.lineno > imp.lineno:
+                yield ctx.finding(
+                    "shadowed-name", stmt,
+                    f"`{name}` rebinds the import from line {imp.lineno}: "
+                    "the import is dead past here (rename one of them)")
+    # Function parameters shadowing a module-level import.
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            for arg in a.posonlyargs + a.args + a.kwonlyargs + \
+                    [x for x in (a.vararg, a.kwarg) if x]:
+                if arg.arg in ctx.imports:
+                    yield ctx.finding(
+                        "shadowed-name", arg,
+                        f"parameter `{arg.arg}` of `{node.name}` shadows "
+                        f"the module-level import (line "
+                        f"{ctx.imports[arg.arg].lineno})")
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run the AST pass over one module's source. ``rules`` narrows to a
+    subset of rule ids (default: all). Suppressions are applied; baseline
+    is the caller's job (it is repo-level state)."""
+    try:
+        ctx = ModuleContext(source, path)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", severity="error", path=path,
+                        line=e.lineno or 1,
+                        message=f"syntax error: {e.msg}")]
+    wanted = set(rules) if rules is not None else None
+    out: List[Finding] = []
+    for rule_id, checker in _CHECKERS:
+        if wanted is not None and rule_id not in wanted:
+            continue
+        out.extend(checker(ctx))
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    apply_suppressions(out, ctx.lines)
+    return out
+
+
+def lint_file(path: str, repo_root: Optional[str] = None,
+              rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    with open(path) as f:
+        source = f.read()
+    rel = os.path.relpath(path, repo_root) if repo_root else path
+    return lint_source(source, rel, rules=rules)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths: Iterable[str], repo_root: Optional[str] = None,
+               rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    out: List[Finding] = []
+    for path in iter_python_files(paths):
+        out.extend(lint_file(path, repo_root=repo_root, rules=rules))
+    return out

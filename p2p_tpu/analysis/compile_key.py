@@ -1,0 +1,210 @@
+"""Compile-key completeness — the ``ProgramCache`` poisoning/churn guard.
+
+``serve.request.prepare`` derives ``compile_key`` by hand: the fields that
+change the XLA program must be in it (two requests sharing a key MUST mean
+the same program — a missing field silently *poisons* the cache: request B
+runs request A's program), and fields that don't change the program must be
+absent (a superfluous field splits one program across many keys — retracing
+churn, and the dynamic batcher can then never co-batch the two requests).
+
+This checker stops trusting the hand-derivation: it sweeps **every**
+``Request`` field, perturbs it against a base request, traces the serve
+batch program each variant would compile (``jax.make_jaxpr`` — structural
+tracing only, no XLA), and asserts both directions per field:
+
+- program changed  ⟹  ``compile_key`` changed   (else: cache poisoning)
+- program unchanged ⟹ ``compile_key`` unchanged (else: retracing churn)
+
+The sweep also fails on any ``Request`` field it has no variant for — a
+*new* field added to the schema cannot dodge the checker by omission.
+
+The program fingerprint is the jaxpr's printed structure: op sequence,
+shapes, dtypes, scan lengths, sub-jaxprs. Constant *values* (e.g. a
+scheduler's sigma table) don't print — a field that changed only trained
+constants of identical shape would be invisible — but every field that can
+change the program today does it structurally (steps → scan length,
+scheduler → different step ops, gate → second scan, controller structure →
+different edit ops).
+
+``key_fn`` swaps the key derivation under test; the regression test masks
+a jaxpr-affecting component through it and asserts the sweep catches the
+seeded omission (the acceptance criterion for this checker).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: Base request every field perturbs against: a 2-prompt replace edit (so
+#: controller-shaping fields are live) with no blend/equalizer (so adding
+#: them is a structure change). Word counts match across prompt variants —
+#: 'replace' requires aligned token counts.
+BASE = dict(
+    request_id="ck-base",
+    prompt="a cat riding a bike",
+    target="a dog riding a bike",
+    mode="replace",
+    steps=3,
+    scheduler="ddim",
+    seed=11,
+    guidance=7.5,
+)
+
+#: field -> (variant value, extra overrides applied to BOTH sides of the
+#: comparison — context a field needs to be meaningful). The extras may
+#: also override the field's own base value (``blend_resolution`` defaults
+#: to 16, which no TINY attention site stores). Every Request field MUST
+#: appear here — the sweep errors on gaps, so extending the schema forces
+#: a decision about program identity.
+VARIANTS: Dict[str, Tuple[object, dict]] = {
+    "request_id": ("ck-other", {}),
+    "prompt": ("a pig riding a bike", {}),
+    "target": ("a fox riding a bike", {}),
+    "mode": ("refine", {}),
+    "cross_steps": (0.5, {}),
+    "self_steps": (0.7, {}),
+    "blend_words": ("bike", {"blend_resolution": 8}),
+    "equalizer": ("bike=2.0", {}),
+    # blend_resolution shapes the LocalBlend mask pooling, so its own
+    # comparison needs a blend in the base — and a base resolution TINY
+    # actually stores (8, not the schema default 16).
+    "blend_resolution": (4, {"blend_words": "bike",
+                             "blend_resolution": 8}),
+    "seed": (7, {}),
+    "steps": (4, {}),
+    "scheduler": ("dpm", {}),
+    "guidance": (3.0, {}),
+    "negative_prompt": ("blurry", {}),
+    "gate": (0.5, {}),
+    "arrival_ms": (125.0, {}),
+    "deadline_ms": (5000.0, {}),
+    "priority": (3, {}),
+}
+
+
+@dataclasses.dataclass
+class FieldVerdict:
+    field: str
+    program_changed: bool
+    key_changed: bool
+
+    @property
+    def ok(self) -> bool:
+        return self.program_changed == self.key_changed
+
+    @property
+    def problem(self) -> str:
+        if self.ok:
+            return ""
+        if self.program_changed:
+            return ("changes the traced program but NOT compile_key — "
+                    "ProgramCache poisoning: two requests differing only "
+                    "in this field would share one compiled program")
+        return ("changes compile_key but NOT the traced program — "
+                "retracing churn: identical programs split across cache "
+                "keys and batching buckets")
+
+    def format(self) -> str:
+        marks = (f"program={'Δ' if self.program_changed else '='} "
+                 f"key={'Δ' if self.key_changed else '='}")
+        return (f"{'ok  ' if self.ok else 'FAIL'} {self.field:18s} {marks}"
+                + (f"  {self.problem}" if not self.ok else ""))
+
+
+def _request(overrides: dict):
+    from ..serve.request import Request
+
+    return Request(**{**BASE, **overrides})
+
+
+def _program_fingerprint(pipe, prep) -> str:
+    """Hash of the serve batch program this prepared request would compile
+    (bucket 1 — bucket only scales the group axis, per-field identity is
+    bucket-independent). Mirrors ``serve.programs.SweepRunner``: same
+    encode calls, same ``_sweep_jit`` entry, same static arguments."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine.sampler import encode_prompts, init_latent
+    from ..models.config import unet_layout
+    from ..ops import schedulers as sched_mod
+    from ..parallel.sweep import _sweep_jit
+
+    req = prep.request
+    cfg = pipe.config
+    layout = unet_layout(cfg.unet)
+    schedule = sched_mod.schedule_from_config(req.steps, cfg.scheduler,
+                                              kind=req.scheduler)
+    cond = encode_prompts(pipe, list(req.prompts))
+    uncond = encode_prompts(pipe,
+                            [req.negative_prompt or ""] * len(req.prompts))
+    ctx = jnp.concatenate([uncond, cond], axis=0)[None]
+    _, lat = init_latent(None, pipe.latent_shape,
+                         jax.random.PRNGKey(req.seed), len(req.prompts))
+    lat = lat[None]
+    ctrl = (None if prep.controller is None else jax.tree_util.tree_map(
+        lambda x: jnp.stack([x]), prep.controller))
+    gs = jnp.float32(req.guidance)
+
+    def run(up, vp, ctx, lat, ctrl, gs):
+        return _sweep_jit(up, vp, cfg, layout, schedule, req.scheduler,
+                          ctx, lat, ctrl, gs, None, progress=False,
+                          gate=prep.gate_step, metrics=False)
+
+    jaxpr = jax.make_jaxpr(run)(pipe.unet_params, pipe.vae_params, ctx,
+                                lat, ctrl, gs)
+    return hashlib.sha256(str(jaxpr).encode()).hexdigest()
+
+
+def check_compile_key(pipe=None,
+                      key_fn: Optional[Callable] = None,
+                      fields: Optional[List[str]] = None
+                      ) -> List[FieldVerdict]:
+    """Sweep every Request field; returns one :class:`FieldVerdict` each.
+
+    ``key_fn(prepared) -> hashable`` overrides the key under test (default:
+    the real ``prepared.compile_key``) — the masking hook the regression
+    test uses. ``fields`` narrows the sweep. Raises ``ValueError`` when a
+    Request field has no sweep variant (schema grew past the checker)."""
+    from ..serve.request import Request, prepare
+
+    if pipe is None:
+        from .contracts import tiny_pipeline
+
+        pipe = tiny_pipeline()
+    key_fn = key_fn or (lambda prep: prep.compile_key)
+
+    declared = {f.name for f in dataclasses.fields(Request)}
+    missing = declared - set(VARIANTS)
+    if missing:
+        raise ValueError(
+            f"Request field(s) {sorted(missing)} have no compile-key sweep "
+            "variant: add them to analysis.compile_key.VARIANTS so the "
+            "completeness check covers the new schema")
+    unknown = set(VARIANTS) - declared
+    if unknown:
+        raise ValueError(f"sweep variant(s) {sorted(unknown)} no longer "
+                         "exist on Request: prune VARIANTS")
+
+    todo = fields if fields is not None else sorted(VARIANTS)
+    fp_cache: Dict[Tuple, str] = {}
+
+    def fingerprint(overrides: dict):
+        prep = prepare(_request(overrides), pipe)
+        cache_key = tuple(sorted(overrides.items()))
+        if cache_key not in fp_cache:
+            fp_cache[cache_key] = _program_fingerprint(pipe, prep)
+        return fp_cache[cache_key], key_fn(prep)
+
+    verdicts = []
+    for field in todo:
+        variant, extra = VARIANTS[field]
+        base_fp, base_key = fingerprint(dict(extra))
+        var_fp, var_key = fingerprint({**extra, field: variant})
+        verdicts.append(FieldVerdict(
+            field=field,
+            program_changed=var_fp != base_fp,
+            key_changed=var_key != base_key))
+    return verdicts

@@ -1,0 +1,406 @@
+"""Pass 2 — traced-program contracts.
+
+Trace the canonical programs of the stack on a tiny pipeline (abstract
+tracing only — ``jax.make_jaxpr``, no XLA compile) and assert jaxpr-level
+contracts that hand-written review keeps re-checking:
+
+- ``no-f64`` — no ``convert_element_type`` to float64 and no f64-dtyped
+  value anywhere in any canonical program. Under the default x64-off
+  config this can only fire on an explicit promotion; it is the tripwire
+  for the day someone enables x64 "just for one test".
+- ``hot-scan-callbacks`` — the phase-2 scan and the serve batch programs
+  carry **zero** host callbacks when telemetry is off (the disabled-mode
+  program-identity discipline), and with telemetry on, the only callback
+  primitive in a hot scan is ``debug_callback`` — the registered obs-sink
+  channel (``utils.progress``). ``io_callback``/``pure_callback`` in a hot
+  scan would serialize the device against the host every step.
+- ``phase2-footprint`` — the phase-2 scan body carries no CFG-doubled
+  ``2B``-batch tensors (the ISSUE 1 jaxpr proof from
+  ``tests/test_phase_cache.py``, generalized to every gated surface
+  including the vmapped serve programs) and is strictly smaller than the
+  phase-1 body.
+- ``donation-as-declared`` — each canonical jitted entry point's buffer
+  donation matches :data:`DECLARED_DONATION`. Today every program declares
+  *no* donation (``_sweep_jit`` spells ``donate_argnums=()`` explicitly —
+  sweep inputs are caller-reused); a future PR that donates must update
+  the declaration, and one that declares without the lowering actually
+  aliasing (or vice versa) fails here.
+
+Programs traced (:func:`canonical_programs`): text2image ungated + gated
+(phase 1/2), serve batch programs across every lane bucket (1/2/4/8, the
+``BUCKET_SIZES`` padding contract), and the two inversion programs. The
+tiny pipeline is the same construction the golden tests use (random
+weights; contracts are shape/structure properties, weights never matter).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from . import jaxpr_walk
+
+#: Steps/gate the canonical programs trace with — small (tracing cost is
+#: linear in scan length only at the python level; the jaxpr scan body is
+#: length-independent) but ≥ 3 so gate=2 leaves both phases non-trivial.
+STEPS = 3
+GATE = 2
+PROMPTS = ("a squirrel eating a burger", "a squirrel eating a lasagna")
+
+#: program name -> donated argument indices the code *declares*. The
+#: contract checks the lowering agrees in both directions.
+DECLARED_DONATION: Dict[str, Tuple[int, ...]] = {
+    "text2image": (),
+    "sweep": (),
+}
+
+
+@dataclasses.dataclass
+class ContractResult:
+    contract: str
+    program: str
+    ok: bool
+    detail: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        return (f"{'ok  ' if self.ok else 'FAIL'} {self.contract:22s} "
+                f"{self.program:18s} {self.detail}")
+
+
+def tiny_pipeline():
+    """The TINY random-weight pipeline (the golden tests' construction,
+    package-local so the analyzer has no test dependency)."""
+    import jax
+
+    from ..engine.sampler import Pipeline
+    from ..models import TINY, init_text_encoder, init_unet
+    from ..models import vae as vae_mod
+    from ..utils.tokenizer import HashWordTokenizer
+
+    tok = HashWordTokenizer(vocab_size=TINY.text.vocab_size,
+                            model_max_length=TINY.text.max_length)
+    return Pipeline(
+        config=TINY,
+        unet_params=init_unet(jax.random.PRNGKey(0), TINY.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(1), TINY.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(2), TINY.vae),
+        tokenizer=tok,
+    )
+
+
+@dataclasses.dataclass
+class Program:
+    """One traced canonical program plus the metadata contracts key on."""
+
+    name: str
+    jaxpr: object                 # ClosedJaxpr
+    group_batch: int              # B (prompts per edit group)
+    gate: Optional[int]           # phase-2 start, None = ungated
+    metrics: bool                 # telemetry traced in?
+    lead_dims: Tuple[int, ...] = ()   # vmap prefix (G,) for serve programs
+    max_tokens: Optional[int] = None  # token-major detector bound
+
+
+def _edit_controller(pipe):
+    from ..cli import controller_from_opts
+
+    return controller_from_opts(list(PROMPTS), pipe.tokenizer, STEPS,
+                                mode="replace", cross_steps=0.8,
+                                self_steps=0.4)
+
+
+def _scan_inputs(pipe):
+    import jax.numpy as jnp
+
+    from ..engine.sampler import encode_prompts
+
+    b = len(PROMPTS)
+    cond = encode_prompts(pipe, list(PROMPTS))
+    uncond = encode_prompts(pipe, [""] * b)
+    ctx = jnp.concatenate([uncond, cond], axis=0)
+    lats = jnp.zeros((b,) + pipe.latent_shape)
+    return ctx, lats, jnp.float32(7.5)
+
+
+def _trace_denoise(pipe, ctrl, gate, metrics):
+    import jax
+
+    from ..engine.sampler import _denoise_scan
+    from ..models.config import unet_layout
+    from ..ops import schedulers as sched_mod
+
+    cfg = pipe.config
+    layout = unet_layout(cfg.unet)
+    schedule = sched_mod.schedule_from_config(STEPS, cfg.scheduler,
+                                              kind="ddim")
+    ctx, lats, gs = _scan_inputs(pipe)
+
+    def run(up, ctx, lats, gs):
+        return _denoise_scan(up, cfg, layout, schedule, "ddim", ctx, lats,
+                             ctrl, gs, gate=gate, metrics=metrics)
+
+    return jax.make_jaxpr(run)(pipe.unet_params, ctx, lats, gs)
+
+
+def _trace_sweep(pipe, ctrl, bucket, gate, metrics):
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.config import unet_layout
+    from ..ops import schedulers as sched_mod
+    from ..parallel.sweep import _sweep_jit
+
+    cfg = pipe.config
+    layout = unet_layout(cfg.unet)
+    schedule = sched_mod.schedule_from_config(STEPS, cfg.scheduler,
+                                              kind="ddim")
+    ctx, lats, gs = _scan_inputs(pipe)
+    ctx_g = jnp.broadcast_to(ctx[None], (bucket,) + ctx.shape)
+    lat_g = jnp.broadcast_to(lats[None], (bucket,) + lats.shape)
+    ctrl_g = (None if ctrl is None else jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (bucket,) + x.shape), ctrl))
+
+    def run(up, vp, ctx_g, lat_g, ctrl_g, gs):
+        return _sweep_jit(up, vp, cfg, layout, schedule, "ddim", ctx_g,
+                          lat_g, ctrl_g, gs, None, progress=False,
+                          gate=gate, metrics=metrics)
+
+    return jax.make_jaxpr(run)(pipe.unet_params, pipe.vae_params, ctx_g,
+                               lat_g, ctrl_g, gs)
+
+
+def _trace_invert(pipe, metrics):
+    """The two inversion programs: DDIM forward-invert and the null-text
+    optimizer outer scan."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine.inversion import _ddim_invert_jit, _null_optimize_jit
+    from ..ops import schedulers as sched_mod
+
+    cfg = pipe.config
+    schedule = sched_mod.schedule_from_config(STEPS, cfg.scheduler,
+                                              kind="ddim")
+    img = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
+    cond = jnp.zeros((1, cfg.unet.context_len, cfg.unet.context_dim))
+    uncond = jnp.zeros_like(cond)
+
+    def run_inv(up, vp, img, cond):
+        return _ddim_invert_jit(up, vp, cfg, schedule, img, cond,
+                                progress=False, sp=None, metrics=metrics)
+
+    inv = jax.make_jaxpr(run_inv)(pipe.unet_params, pipe.vae_params, img,
+                                  cond)
+
+    lat_shape = (STEPS + 1, 1) + pipe.latent_shape
+    lats = jnp.zeros(lat_shape)
+
+    def run_null(up, lats, cond, uncond):
+        return _null_optimize_jit(up, cfg, schedule, lats, uncond, cond,
+                                  jnp.float32(7.5), 2, jnp.float32(1e-5),
+                                  progress=False, sp=None, metrics=metrics)
+
+    null = jax.make_jaxpr(run_null)(pipe.unet_params, lats, cond, uncond)
+    return inv, null
+
+
+def canonical_programs(pipe=None, buckets=(1, 2, 4, 8),
+                       metrics=False) -> List[Program]:
+    """Trace every canonical program of the stack. ``metrics`` traces the
+    telemetry variant (used by the hot-scan-callback contract's
+    only-debug-callback half)."""
+    if pipe is None:
+        pipe = tiny_pipeline()
+    b = len(PROMPTS)
+    ctrl = _edit_controller(pipe)
+    programs = [
+        Program("text2image/ungated",
+                _trace_denoise(pipe, ctrl, gate=None, metrics=metrics),
+                group_batch=b, gate=None, metrics=metrics),
+        Program("text2image/gated",
+                _trace_denoise(pipe, ctrl, gate=GATE, metrics=metrics),
+                group_batch=b, gate=GATE, metrics=metrics),
+    ]
+    for g in buckets:
+        programs.append(Program(
+            f"serve/bucket{g}",
+            _trace_sweep(pipe, ctrl, bucket=g, gate=GATE, metrics=metrics),
+            group_batch=b, gate=GATE, metrics=metrics, lead_dims=(g,)))
+    inv, null = _trace_invert(pipe, metrics=metrics)
+    programs.append(Program("invert/ddim", inv, group_batch=1, gate=None,
+                            metrics=metrics))
+    programs.append(Program("invert/null_text", null, group_batch=1,
+                            gate=None, metrics=metrics))
+    return programs
+
+
+# ---------------------------------------------------------------------------
+# Contracts
+# ---------------------------------------------------------------------------
+
+
+def check_no_f64(programs: List[Program]) -> List[ContractResult]:
+    out = []
+    for p in programs:
+        bad = jaxpr_walk.f64_eqns(jaxpr_walk.all_eqns(p.jaxpr))
+        detail = (f"{len(bad)} f64 eqn(s), first: "
+                  f"{bad[0].primitive.name}" if bad else "no f64 values")
+        out.append(ContractResult("no-f64", p.name, not bad, detail))
+    return out
+
+
+def _hot_scans(p: Program) -> List[Tuple[str, list]]:
+    """(label, body eqns) of the hot scans a program carries: for a gated
+    program, the phase-2 scan (last top-level scan); serve programs are hot
+    end to end, so every scan counts."""
+    scans = jaxpr_walk.top_level_scans(p.jaxpr)
+    if not scans:
+        return []
+    if p.name.startswith("serve/"):
+        return [(f"scan{i}", jaxpr_walk.scan_body(s))
+                for i, s in enumerate(scans)]
+    if p.gate is not None:
+        return [("phase2", jaxpr_walk.scan_body(scans[-1]))]
+    return []
+
+
+def check_hot_scan_callbacks(programs: List[Program]) -> List[ContractResult]:
+    out = []
+    for p in programs:
+        for label, body in _hot_scans(p):
+            cbs = jaxpr_walk.callback_eqns(body)
+            if not p.metrics:
+                ok = not cbs
+                detail = (f"{label}: {len(cbs)} callback(s) with telemetry "
+                          f"off" if cbs else f"{label}: no callbacks")
+            else:
+                alien = [e for e in cbs
+                         if e.primitive.name != "debug_callback"]
+                ok = not alien
+                detail = (f"{label}: non-obs callback(s) "
+                          f"{sorted({e.primitive.name for e in alien})}"
+                          if alien else
+                          f"{label}: {len(cbs)} debug_callback(s) only")
+            out.append(ContractResult("hot-scan-callbacks", p.name, ok,
+                                      detail))
+    return out
+
+
+def check_phase2_footprint(programs: List[Program]) -> List[ContractResult]:
+    """The generalized ISSUE 1 proof: phase 2 carries no CFG-doubled batch
+    and is strictly smaller than phase 1 — on every gated surface."""
+    out = []
+    for p in programs:
+        if p.gate is None or p.name.startswith("invert/"):
+            continue
+        scans = jaxpr_walk.top_level_scans(p.jaxpr)
+        if len(scans) != 2:
+            out.append(ContractResult(
+                "phase2-footprint", p.name, False,
+                f"expected a two-phase scan, found {len(scans)} top-level "
+                "scan(s)"))
+            continue
+        body1 = jaxpr_walk.scan_body(scans[0])
+        body2 = jaxpr_walk.scan_body(scans[1])
+
+        # Inside a vmapped serve program the uncond half can appear two
+        # ways: batched tensors with an explicit (G, 2B, ...) prefix, or
+        # conv activations where vmap folded the group axis into the batch
+        # axis — (G·2B, h, w, c). The unbatched programs use the plain
+        # (2B, ...) detector. Only these exact forms count: an unqualified
+        # leading-dim match would collide with G·B phase-2 activations
+        # whenever G·B == 2B (bucket 2 at B=2).
+        def doubled(body):
+            shapes = jaxpr_walk.eqn_shapes(body)
+            if not p.lead_dims:
+                return jaxpr_walk.doubled_batch_shapes(shapes,
+                                                       p.group_batch)
+            g = p.lead_dims[0]
+            return (jaxpr_walk.doubled_batch_shapes(
+                        shapes, p.group_batch, lead_dims=p.lead_dims)
+                    + jaxpr_walk.folded_batch_shapes(
+                        shapes, g * 2 * p.group_batch))
+
+        d1, d2 = doubled(body1), doubled(body2)
+        if not d1:
+            out.append(ContractResult(
+                "phase2-footprint", p.name, False,
+                "detector vacuous: phase 1 carries no CFG-doubled batch"))
+            continue
+        ok = not d2 and len(body2) < len(body1)
+        detail = (f"phase2 {len(body2)} eqns < phase1 {len(body1)}, "
+                  f"no 2B tensors" if ok else
+                  (f"phase2 still carries 2B tensors: "
+                   f"{sorted(set(d2))[:4]}" if d2 else
+                   f"phase2 body ({len(body2)} eqns) not smaller than "
+                   f"phase1 ({len(body1)})"))
+        out.append(ContractResult("phase2-footprint", p.name, ok, detail))
+    return out
+
+
+def _donated_params(lowered_text: str) -> int:
+    """Count donated parameters in a lowering's StableHLO text: XLA marks
+    them ``jax.buffer_donor`` (or legacy ``tf.aliasing_output``)."""
+    return (lowered_text.count("jax.buffer_donor")
+            + lowered_text.count("tf.aliasing_output"))
+
+
+def check_donation(pipe=None) -> List[ContractResult]:
+    """Lower the two jitted entry points and check buffer donation against
+    :data:`DECLARED_DONATION` — both directions (declared-but-absent and
+    applied-but-undeclared fail)."""
+    from ..engine.sampler import _text2image_jit
+    from ..models.config import unet_layout
+    from ..ops import schedulers as sched_mod
+    from ..parallel.sweep import _sweep_jit
+
+    if pipe is None:
+        pipe = tiny_pipeline()
+    cfg = pipe.config
+    layout = unet_layout(cfg.unet)
+    schedule = sched_mod.schedule_from_config(STEPS, cfg.scheduler,
+                                              kind="ddim")
+    ctx, lats, gs = _scan_inputs(pipe)
+    b = len(PROMPTS)
+    cond, uncond = ctx[b:], ctx[:b]
+
+    lowerings = {
+        "text2image": _text2image_jit.lower(
+            pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
+            "ddim", cond, uncond, lats, None, gs, None, False,
+            progress=False, sp=None, gate=None, metrics=False),
+        "sweep": _sweep_jit.lower(
+            pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
+            "ddim", ctx[None], lats[None], None, gs, None, progress=False,
+            gate=None, metrics=False),
+    }
+    out = []
+    for name, declared in DECLARED_DONATION.items():
+        n = _donated_params(lowerings[name].as_text())
+        ok = (n > 0) == (len(declared) > 0)
+        detail = (f"{n} donated param(s) in lowering, "
+                  f"{len(declared)} declared")
+        out.append(ContractResult("donation-as-declared", name, ok, detail))
+    return out
+
+
+def run_contracts(pipe=None, buckets=(1, 2, 4, 8)) -> List[ContractResult]:
+    """All jaxpr contracts over all canonical programs (telemetry off and
+    on), plus the donation check. The compile-key completeness sweep lives
+    in :mod:`.compile_key` (it needs per-Request tracing, not the canonical
+    set)."""
+    if pipe is None:
+        pipe = tiny_pipeline()
+    plain = canonical_programs(pipe, buckets=buckets, metrics=False)
+    instrumented = canonical_programs(pipe, buckets=buckets[:1],
+                                      metrics=True)
+    results: List[ContractResult] = []
+    results += check_no_f64(plain)
+    results += check_hot_scan_callbacks(plain)
+    results += check_hot_scan_callbacks(instrumented)
+    results += check_phase2_footprint(plain)
+    results += check_donation(pipe)
+    return results

@@ -1,0 +1,197 @@
+"""Finding model shared by both analysis passes, plus the two mechanisms
+that keep the linter adoptable on a codebase with history:
+
+- **Inline suppressions** — ``# jaxcheck: disable=<rule>[,<rule>...]`` on the
+  flagged line or on the line directly above it. The code-review contract:
+  an *intentional* pattern gets an inline disable (next to a reason), so the
+  exemption lives where the code lives and travels with it in diffs.
+- **A committed baseline** — a JSON file of fingerprints for pre-existing
+  findings. Findings matching the baseline are reported but don't fail the
+  gate; anything *new* does. Fingerprints are ``(rule, path, source line
+  text)`` — deliberately line-number-free, so unrelated edits above a
+  baselined finding don't resurrect it.
+
+The repo ships an **empty** baseline (tools/jaxcheck_baseline.json): every
+pre-existing finding was either fixed or inline-disabled with a reason when
+the analyzer landed. The baseline mechanism exists for future rule
+*additions*, where fixing the whole backlog in the rule-introducing PR may
+not be reasonable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import re
+import tokenize
+from typing import Dict, List, Optional, Tuple
+
+#: Severities, in gate order. "error" findings are correctness hazards
+#: (host sync in a hot scan); "warning" findings are hygiene (dead import).
+#: Both fail the gate when new — severity is for human triage, not for
+#: deciding what CI ignores.
+SEVERITIES = ("error", "warning")
+
+# The rule list is comma-separated rule tokens; the match stops at the
+# first non-token text so a trailing reason ("... disable=host-sync --
+# intentional: X") never swallows into the rule list.
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxcheck\s*:\s*disable\s*=\s*"
+    r"([A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)")
+
+#: The canonical spelling `fixes.normalize_suppressions` rewrites to.
+SUPPRESS_CANONICAL = "# jaxcheck: disable="
+
+
+@dataclasses.dataclass
+class Finding:
+    """One analyzer hit. ``path`` is repo-relative wherever possible (the
+    fingerprint must be stable across checkouts)."""
+
+    rule: str
+    severity: str
+    path: str
+    line: int            # 1-based
+    message: str
+    source_line: str = ""  # stripped text of the flagged line
+    suppressed: bool = False
+    baselined: bool = False
+
+    @property
+    def fingerprint(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.source_line)
+
+    @property
+    def is_new(self) -> bool:
+        return not (self.suppressed or self.baselined)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        state = ("" if self.is_new
+                 else (" [suppressed]" if self.suppressed else " [baseline]"))
+        return (f"{self.path}:{self.line}: {self.severity} "
+                f"[{self.rule}] {self.message}{state}")
+
+
+def comment_columns(source_lines: List[str]) -> Dict[int, int]:
+    """1-based line number -> column where that line's comment starts,
+    tokenize-accurate: a ``#`` inside a string literal is NOT a comment, so
+    directive-looking text in docstrings/strings can never suppress (or be
+    rewritten by ``--fix``)."""
+    cols: Dict[int, int] = {}
+    src = "\n".join(source_lines) + "\n"
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                cols[tok.start[0]] = tok.start[1]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # partial/odd sources: keep whatever tokenized cleanly
+    return cols
+
+
+def suppressed_rules(source_lines: List[str], line: int,
+                     cols: Optional[Dict[int, int]] = None) -> set:
+    """Rules disabled for 1-based ``line``: a trailing ``# jaxcheck:
+    disable=`` comment on the line itself, or a comment on the line
+    directly above (the whole-line form, for when the flagged line has no
+    room). Returns the union. ``cols`` is a precomputed
+    :func:`comment_columns` table (recomputed here when absent)."""
+    if cols is None:
+        cols = comment_columns(source_lines)
+    rules: set = set()
+    for idx in (line - 1, line - 2):  # 0-based: the line, then the one above
+        if not 0 <= idx < len(source_lines):
+            continue
+        col = cols.get(idx + 1)
+        if col is None:
+            continue  # no real comment on this line
+        if idx == line - 2 and source_lines[idx][:col].strip():
+            continue  # the above-line form must be a standalone comment
+        m = _SUPPRESS_RE.search(source_lines[idx], col)
+        if m:
+            rules |= {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return rules
+
+
+def apply_suppressions(findings: List[Finding],
+                       source_lines: List[str]) -> None:
+    """Mark findings whose line (or the line above) carries a matching
+    inline disable."""
+    cols = comment_columns(source_lines)
+    for f in findings:
+        if f.rule in suppressed_rules(source_lines, f.line, cols):
+            f.suppressed = True
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> List[dict]:
+    """Read a baseline file → list of fingerprint dicts (missing file =
+    empty baseline: everything is new)."""
+    import os
+
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "findings" not in doc:
+        raise ValueError(f"baseline {path}: expected "
+                         '{"version": 1, "findings": [...]}')
+    return list(doc["findings"])
+
+
+def save_baseline(path: str, findings: List[Finding]) -> None:
+    """Write the current *new* findings as the baseline (``--update-
+    baseline``). Suppressed findings are excluded — an inline disable is
+    already a durable exemption; baselining it too would hide a later
+    removal of the comment."""
+    entries = [{"rule": f.rule, "path": f.path, "code": f.source_line}
+               for f in findings if not f.suppressed]
+    entries.sort(key=lambda e: (e["path"], e["rule"], e["code"]))
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=1)
+        fh.write("\n")
+
+
+def apply_baseline(findings: List[Finding], baseline: List[dict]) -> None:
+    """Mark findings matching a baseline fingerprint. Matching consumes
+    entries (a multiset match): two identical offending lines need two
+    baseline entries, so deleting one of them surfaces the other as
+    still-baselined, not new."""
+    pool: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline:
+        key = (e.get("rule", ""), e.get("path", ""), e.get("code", ""))
+        pool[key] = pool.get(key, 0) + 1
+    for f in findings:
+        if f.suppressed:
+            continue
+        n = pool.get(f.fingerprint, 0)
+        if n > 0:
+            pool[f.fingerprint] = n - 1
+            f.baselined = True
+
+
+def summarize(findings: List[Finding]) -> dict:
+    new = [f for f in findings if f.is_new]
+    return {
+        "total": len(findings),
+        "new": len(new),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "baselined": sum(1 for f in findings if f.baselined),
+        "by_rule": _count_by(new, "rule"),
+        "by_severity": _count_by(new, "severity"),
+    }
+
+
+def _count_by(findings: List[Finding], attr: str) -> dict:
+    out: Dict[str, int] = {}
+    for f in findings:
+        key = getattr(f, attr)
+        out[key] = out.get(key, 0) + 1
+    return dict(sorted(out.items()))

@@ -1,0 +1,144 @@
+"""``jaxcheck --fix`` — best-effort mechanical fixes.
+
+Only rules whose fix is a pure text transformation with no behavioral
+judgment are fixable:
+
+- ``unused-import`` — remove the dead name from its import statement
+  (dropping the whole statement when every name it binds is dead).
+- suppression formatting — normalize ``#jaxcheck:disable = x`` spelling
+  variants to the canonical ``# jaxcheck: disable=x`` so grep and the
+  suppression scanner agree.
+
+Everything else (traced branches, host syncs, mutable defaults) needs a
+human: the fix changes semantics. The fixer re-lints after rewriting, so a
+fix can never *introduce* a finding silently — if it would, the file is
+left untouched and reported.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from . import astlint
+# One regex for scanner and fixer: if they ever diverged, --fix could
+# normalize to a spelling the suppression scanner parses differently.
+from .findings import _SUPPRESS_RE, SUPPRESS_CANONICAL, comment_columns
+
+
+def normalize_suppressions(source: str) -> Tuple[str, int]:
+    """Rewrite suppression comments to the canonical spelling, preserving
+    indentation and any trailing reason text after the rule list. Only real
+    comments are touched (tokenize-verified) — directive-looking text
+    inside string literals/docstrings is content, not a directive. Returns
+    (new_source, n_changed)."""
+    changed = 0
+    out_lines: List[str] = []
+    cols = comment_columns(source.splitlines())
+    for i, line in enumerate(source.splitlines(keepends=True)):
+        eol = line[len(line.rstrip("\r\n")):]
+        body = line.rstrip("\r\n")
+        col = cols.get(i + 1)
+        m = _SUPPRESS_RE.search(body, col) if col is not None else None
+        if m:
+            rules = ",".join(r.strip() for r in m.group(1).split(",")
+                             if r.strip())
+            canonical = SUPPRESS_CANONICAL + rules
+            if body[m.start():m.end()] != canonical:
+                prefix = body[:m.start()]
+                if prefix.strip():   # trailing-comment form: code + 2 sp
+                    prefix = prefix.rstrip() + "  "
+                # else: standalone comment — keep the indentation verbatim
+                body = prefix + canonical + body[m.end():]
+                changed += 1
+        out_lines.append(body + eol)
+    return "".join(out_lines), changed
+
+
+def remove_unused_imports(source: str, path: str = "<string>"
+                          ) -> Tuple[str, int]:
+    """Drop dead imported names reported by the ``unused-import`` rule.
+    Returns (new_source, n_removed). Only single-line import statements are
+    rewritten (multi-line imports are rare in this repo and not worth the
+    reconstruction risk in a best-effort tool)."""
+    findings = [f for f in astlint.lint_source(source, path,
+                                               rules=("unused-import",))
+                if f.is_new]
+    if not findings:
+        return source, 0
+    dead = {}  # line (1-based) -> set of dead names
+    for f in findings:
+        name = f.message.split("`")[1]
+        dead.setdefault(f.line, set()).add(name)
+
+    lines = source.splitlines(keepends=True)
+    tree = ast.parse(source)
+    removed = 0
+    for stmt in list(ast.walk(tree)):
+        if not isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            continue
+        names = dead.get(stmt.lineno)
+        if not names or stmt.end_lineno != stmt.lineno:
+            continue
+        keep = []
+        for a in stmt.names:
+            bound = (a.asname or a.name).split(".")[0]
+            if bound in names:
+                removed += 1
+            else:
+                keep.append(a)
+        idx = stmt.lineno - 1
+        eol = lines[idx][len(lines[idx].rstrip("\r\n")):]
+        indent = lines[idx][:len(lines[idx]) - len(lines[idx].lstrip())]
+        if not keep:
+            lines[idx] = ""
+        else:
+            rendered = ", ".join(a.name + (f" as {a.asname}" if a.asname
+                                           else "") for a in keep)
+            if isinstance(stmt, ast.ImportFrom):
+                dots = "." * stmt.level
+                lines[idx] = (f"{indent}from {dots}{stmt.module or ''} "
+                              f"import {rendered}{eol}")
+            else:
+                lines[idx] = f"{indent}import {rendered}{eol}"
+    return "".join(lines), removed
+
+
+def fix_source(source: str, path: str = "<string>") -> Tuple[str, dict]:
+    """Apply every mechanical fix; returns (new_source, counts). Refuses a
+    rewrite that fails to parse or that introduces new findings (returns
+    the original source with ``counts['aborted']`` set)."""
+    counts = {"unused_imports_removed": 0, "suppressions_normalized": 0}
+    new, n = remove_unused_imports(source, path)
+    counts["unused_imports_removed"] = n
+    new, n = normalize_suppressions(new)
+    counts["suppressions_normalized"] = n
+    if new == source:
+        return source, counts
+    try:
+        before = {f.fingerprint for f in astlint.lint_source(source, path)
+                  if f.is_new}
+        after = [f for f in astlint.lint_source(new, path) if f.is_new]
+    except SyntaxError:
+        return source, {**counts, "aborted": "rewrite failed to parse"}
+    introduced = [f for f in after if f.fingerprint not in before]
+    if introduced:
+        return source, {**counts,
+                        "aborted": f"rewrite would introduce "
+                                   f"{len(introduced)} new finding(s)"}
+    return new, counts
+
+
+def fix_file(path: str, repo_root: Optional[str] = None) -> dict:
+    import os
+
+    with open(path) as f:
+        source = f.read()
+    rel = os.path.relpath(path, repo_root) if repo_root else path
+    new, counts = fix_source(source, rel)
+    counts["path"] = rel
+    counts["changed"] = new != source
+    if new != source:
+        with open(path, "w") as f:
+            f.write(new)
+    return counts

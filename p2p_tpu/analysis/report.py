@@ -1,0 +1,161 @@
+"""Assemble both passes into one structured report.
+
+The report is the analyzer's single output contract — ``tools/jaxcheck.py``
+prints/serializes it, ``tools/quality_gate.py``'s ``static_analysis`` check
+consumes it, and ``p2p-tpu check --static`` wraps it. Shape:
+
+.. code-block:: json
+
+    {"version": 1,
+     "ok": true,
+     "ast": {"findings": [...], "summary": {"new": 0, ...}},
+     "contracts": {"results": [...], "ok": true},
+     "compile_key": {"fields": [...], "ok": true}}
+
+``ok`` is the gate verdict: no *new* AST findings (suppressed/baselined
+don't count) and every contract + compile-key field verdict holding.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Optional
+
+from . import astlint
+from .findings import apply_baseline, load_baseline, summarize
+
+REPORT_VERSION = 1
+
+#: Default lint targets, relative to the repo root: the package plus the
+#: drivers that embed repo invariants. tests/ is deliberately out — tests
+#: exercise anti-patterns on purpose (fixture snippets for these very
+#: rules would self-flag). tools/profiling/ is out too: those are
+#: standalone on-accelerator scratch harnesses whose module scope *is*
+#: their main() — import-time jax is their point, not a hazard.
+DEFAULT_LINT_PATHS = ("p2p_tpu", "tools/quality_gate.py",
+                      "tools/jaxcheck.py", "tools/loadgen.py",
+                      "tools/chaos_drill.py", "tools/check_checkpoint.py",
+                      "tools/parity_real_weights.py",
+                      "bench.py", "__graft_entry__.py")
+
+DEFAULT_BASELINE = os.path.join("tools", "jaxcheck_baseline.json")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_ast_pass(paths: Optional[Iterable[str]] = None,
+                 baseline_path: Optional[str] = None,
+                 root: Optional[str] = None) -> dict:
+    """Pass 1 over ``paths`` (default: the package + drivers), baselined
+    against ``baseline_path`` (default: the committed baseline; pass "" to
+    skip baselining)."""
+    root = root or repo_root()
+    abs_paths = [p if os.path.isabs(p) else os.path.join(root, p)
+                 for p in (paths if paths is not None else
+                           DEFAULT_LINT_PATHS)]
+    # A missing target is an error, never a silent skip: a typo'd CI path
+    # (or a renamed default) would otherwise report clean forever.
+    missing = [p for p in abs_paths if not os.path.exists(p)]
+    if missing:
+        raise FileNotFoundError(
+            f"lint target(s) do not exist: {missing}")
+    findings = astlint.lint_paths(abs_paths, repo_root=root)
+    if baseline_path is None:
+        baseline_path = os.path.join(root, DEFAULT_BASELINE)
+    if baseline_path:
+        apply_baseline(findings, load_baseline(baseline_path))
+    return {"findings": findings, "summary": summarize(findings)}
+
+
+def run_contract_pass(pipe=None, buckets=(1, 2, 4, 8),
+                      compile_key_fields: Optional[List[str]] = None) -> dict:
+    """Pass 2: jaxpr contracts + the compile-key completeness sweep. Built
+    lazily so the AST-only path never imports jax."""
+    from . import compile_key as ck_mod
+    from . import contracts as contracts_mod
+
+    if pipe is None:
+        pipe = contracts_mod.tiny_pipeline()
+    results = contracts_mod.run_contracts(pipe, buckets=buckets)
+    verdicts = ck_mod.check_compile_key(pipe, fields=compile_key_fields)
+    return {
+        "contracts": {"results": results,
+                      "ok": all(r.ok for r in results)},
+        "compile_key": {"fields": verdicts,
+                        "ok": all(v.ok for v in verdicts)},
+    }
+
+
+def run_all(paths: Optional[Iterable[str]] = None,
+            baseline_path: Optional[str] = None,
+            root: Optional[str] = None,
+            ast_only: bool = False,
+            buckets=(1, 2, 4, 8)) -> dict:
+    ast = run_ast_pass(paths, baseline_path=baseline_path, root=root)
+    report = {"version": REPORT_VERSION, "ast": ast}
+    if ast_only:
+        report["ok"] = ast["summary"]["new"] == 0
+        return report
+    passes = run_contract_pass(buckets=buckets)
+    report.update(passes)
+    report["ok"] = (ast["summary"]["new"] == 0
+                    and passes["contracts"]["ok"]
+                    and passes["compile_key"]["ok"])
+    return report
+
+
+def to_json_dict(report: dict) -> dict:
+    """The report with dataclasses rendered to plain dicts (the JSON file
+    quality_gate and CI artifacts consume)."""
+    out = {"version": report["version"], "ok": report["ok"],
+           "ast": {"findings": [f.to_dict()
+                                for f in report["ast"]["findings"]],
+                   "summary": report["ast"]["summary"]}}
+    if "contracts" in report:
+        out["contracts"] = {
+            "ok": report["contracts"]["ok"],
+            "results": [r.to_dict()
+                        for r in report["contracts"]["results"]]}
+    if "compile_key" in report:
+        out["compile_key"] = {
+            "ok": report["compile_key"]["ok"],
+            "fields": [{"field": v.field,
+                        "program_changed": v.program_changed,
+                        "key_changed": v.key_changed,
+                        "ok": v.ok, "problem": v.problem}
+                       for v in report["compile_key"]["fields"]]}
+    return out
+
+
+def render_text(report: dict, verbose: bool = False) -> str:
+    """Human-readable rendering (the CLI's default output)."""
+    lines: List[str] = []
+    s = report["ast"]["summary"]
+    lines.append(f"AST pass: {s['new']} new finding(s) "
+                 f"({s['suppressed']} suppressed, {s['baselined']} "
+                 f"baselined, {s['total']} total)")
+    for f in report["ast"]["findings"]:
+        if f.is_new or verbose:
+            lines.append("  " + f.format())
+    if "contracts" in report:
+        c = report["contracts"]
+        lines.append(f"Contract pass: "
+                     f"{sum(1 for r in c['results'] if not r.ok)} "
+                     f"failure(s) across {len(c['results'])} check(s)")
+        for r in c["results"]:
+            if not r.ok or verbose:
+                lines.append("  " + r.format())
+    if "compile_key" in report:
+        k = report["compile_key"]
+        lines.append(f"Compile-key sweep: "
+                     f"{sum(1 for v in k['fields'] if not v.ok)} "
+                     f"violation(s) across {len(k['fields'])} field(s)")
+        for v in k["fields"]:
+            if not v.ok or verbose:
+                lines.append("  " + v.format())
+    lines.append("static analysis " + ("PASSED" if report["ok"]
+                                       else "FAILED"))
+    return "\n".join(lines)

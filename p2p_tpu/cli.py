@@ -562,6 +562,31 @@ def cmd_serve(args) -> int:
 
 
 def cmd_check(args) -> int:
+    if args.ast_only and not args.static:
+        # Honored-flags discipline: never accept-and-ignore.
+        raise SystemExit("--ast-only only applies to --static")
+    if args.static:
+        if args.checkpoint_dir or args.preset:
+            raise SystemExit("--static is the whole-stack analyzer; it "
+                             "takes no checkpoint_dir/--preset")
+        if not args.ast_only:
+            # Same backend pinning as tools/jaxcheck.py: the contract pass
+            # is a structure check, never device work — tracing on an
+            # accelerator would initialize it (and could lower donation
+            # differently), diverging from the CI driver's CPU verdict.
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        from .analysis import report as report_mod
+
+        report = report_mod.run_all(ast_only=args.ast_only)
+        print(report_mod.render_text(report))
+        return 0 if report["ok"] else 1
+    if not args.checkpoint_dir or not args.preset:
+        raise SystemExit("check needs a checkpoint_dir and --preset "
+                         "(or --static for the static analyzer)")
     from .models.checkpoint_check import _print_report, check_checkpoint
 
     rep = check_checkpoint(args.checkpoint_dir, args.preset)
@@ -796,10 +821,20 @@ def build_parser() -> argparse.ArgumentParser:
     s.set_defaults(fn=cmd_serve)
 
     c = sub.add_parser(
-        "check", help="checkpoint-readiness report (no weights loaded)")
-    c.add_argument("checkpoint_dir")
-    c.add_argument("--preset", required=True,
+        "check", help="checkpoint-readiness report (no weights loaded), "
+                      "or --static: the jaxcheck static analyzer")
+    c.add_argument("checkpoint_dir", nargs="?", default=None)
+    c.add_argument("--preset", default=None,
                    choices=("sd14", "sd21", "sd21base", "ldm256"))
+    c.add_argument("--static", action="store_true",
+                   help="run the two-pass static analyzer instead (AST "
+                        "lints + traced-program contracts — "
+                        "docs/STATIC_ANALYSIS.md); exits nonzero on new "
+                        "findings or contract violations. Full flag "
+                        "surface: tools/jaxcheck.py")
+    c.add_argument("--ast-only", action="store_true",
+                   help="with --static: skip the (slower) traced-program "
+                        "contract pass")
     c.set_defaults(fn=cmd_check)
     return p
 

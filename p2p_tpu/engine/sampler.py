@@ -29,6 +29,7 @@ from typing import TYPE_CHECKING, Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 if TYPE_CHECKING:  # the sp plan type; runtime stays import-cycle-free
     from ..models.unet import SpConfig
@@ -74,14 +75,30 @@ def _encode_jit(params, cfg, ids, dtype):
     return apply_text_encoder(params, cfg, ids, dtype=dtype)
 
 
+def stage_host(x):
+    """Explicitly stage a host value onto the default device — the h2d
+    form that passes ``jax.transfer_guard("disallow")``, which the serve
+    dispatch hot path runs under (tests/test_serve.py). On a multiprocess
+    mesh ``jax.device_put`` of an unsharded value runs a cross-host
+    equality collective the CPU backend can't execute, so multihost runs
+    keep the implicit path — the transfer-guard contract is a
+    single-process serving property."""
+    if jax.process_count() > 1:
+        return jnp.asarray(x)
+    return jax.device_put(x)
+
+
 def encode_prompts(pipe: Pipeline, prompts, dtype=jnp.float32) -> jax.Array:
     """Tokenize + encode to (B, L, D) hidden states
     (`/root/reference/ptp_utils.py:144-156`)."""
     tok = pipe.tokenizer
     max_len = pipe.config.unet.context_len
-    ids = jnp.asarray(
+    # Token ids are the one host-born input of every dispatch: staged
+    # explicitly (stage_host) so the serve hot path stays clean under
+    # jax.transfer_guard("disallow").
+    ids = stage_host(np.asarray(
         [pad_ids(tok.encode(p), max_len, getattr(tok, "pad_token_id", tok.eos_token_id))
-         for p in prompts], dtype=jnp.int32)
+         for p in prompts], dtype=np.int32))
     return _encode_jit(pipe.text_params, pipe.config.text, ids, dtype)
 
 

@@ -25,6 +25,7 @@ step count with the same shapes.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -118,11 +119,19 @@ def make_schedule(
     )
 
 
+@functools.lru_cache(maxsize=64)
 def schedule_from_config(num_inference_steps: int, sched_cfg, kind: Optional[str] = None,
                          dtype=jnp.float32) -> DiffusionSchedule:
     """Build the schedule a backend's :class:`SchedulerConfig` describes,
     optionally overriding the sampler kind (the reference uses PNDM for the
-    CLI path and DDIM for null-text on the same SD backend)."""
+    CLI path and DDIM for null-text on the same SD backend).
+
+    Cached per ``(steps, config, kind, dtype)``: the schedule is a pure
+    function of its arguments, and rebuilding it per call re-transferred the
+    (num_train,) constant tables host→device on *every* serve batch — the
+    hot-path transfer the ``jax.transfer_guard("disallow")`` test pins away
+    (the schedule is immutable — a frozen struct.dataclass of arrays — so
+    sharing one instance across callers is safe)."""
     kind = kind or sched_cfg.kind
     return make_schedule(
         num_inference_steps,

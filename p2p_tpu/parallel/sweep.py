@@ -18,10 +18,12 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..controllers.base import AttnLayout, Controller
-from ..engine.sampler import _denoise_scan, resolve_gate, warn_gate_truncation
+from ..engine.sampler import (_denoise_scan, resolve_gate, stage_host,
+                              warn_gate_truncation)
 from ..models import vae as vae_mod
 from ..models.config import PipelineConfig
 from ..ops import schedulers as sched_mod
@@ -133,7 +135,12 @@ def sweep(
     # truncates edit windows / freezes an explicit store must not be
     # silent just because the run is batched.
     warn_gate_truncation(gate_step, schedule.timesteps.shape[0], controllers)
-    gs = jnp.asarray(guidance_scale, jnp.float32)
+    # Explicit staging when the scale arrives as a host scalar: the serve
+    # loop dispatches under jax.transfer_guard("disallow"), where an
+    # implicit jnp.asarray(float) h2d would raise (already-on-device values
+    # pass through untouched).
+    gs = (guidance_scale if isinstance(guidance_scale, jax.Array)
+          else stage_host(np.float32(guidance_scale)))
 
     if mesh is not None:
         gspec = NamedSharding(mesh, P("dp"))

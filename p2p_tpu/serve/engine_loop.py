@@ -81,7 +81,7 @@ from .batcher import BUCKET_SIZES, Batch, DynamicBatcher, bucket_for
 from .faults import RetryPolicy
 from .programs import ProgramCache, default_runner_factory
 from .queue import AdmissionQueue, Rejected
-from .request import Cancel, PreparedRequest, Request, prepare
+from .request import Cancel, Request, prepare
 
 #: Every terminal status a request can resolve to. Single-sourced from the
 #: WAL module: the journal is the durability contract, so the set of

@@ -36,7 +36,7 @@ import dataclasses
 import hashlib
 import threading
 import time
-from typing import Callable, Optional, Tuple
+from typing import Callable, Optional
 
 TRANSIENT = "transient"
 POISON = "poison"

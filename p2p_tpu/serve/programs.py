@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import time
 from collections import OrderedDict
-from typing import Callable, Optional, Tuple
+from typing import Callable, Tuple
 
 from ..obs import metrics as obs_metrics
 from ..utils.cache import ensure_persistent_cache
@@ -160,8 +160,9 @@ class SweepRunner:
     def _inputs(self, entries, zeros: bool = False):
         import jax
         import jax.numpy as jnp
+        import numpy as np
 
-        from ..engine.sampler import encode_prompts, init_latent
+        from ..engine.sampler import encode_prompts, init_latent, stage_host
 
         ctxs, lats, ctrls = [], [], []
         for e in entries:
@@ -170,8 +171,17 @@ class SweepRunner:
             uncond = encode_prompts(
                 self.pipe, [req.negative_prompt or ""] * len(req.prompts))
             ctxs.append(jnp.concatenate([uncond, cond], axis=0))
+            # The seed is staged explicitly (np.int32 is exactly what
+            # PRNGKey(int) resolves to under x64-off, so keys — and lanes —
+            # stay bitwise-identical): PRNGKey(python_int) is an implicit
+            # h2d transfer per lane, disallowed under the dispatch
+            # transfer guard. Seeds outside int32 range keep the python-int
+            # path — PRNGKey folds 64-bit ints natively, while np.int32
+            # would raise (and an x64-off device stage would truncate).
+            seed = (stage_host(np.int32(req.seed))
+                    if -2**31 <= req.seed < 2**31 else req.seed)
             _, lat_b = init_latent(None, self.pipe.latent_shape,
-                                   jax.random.PRNGKey(req.seed),
+                                   jax.random.PRNGKey(seed),
                                    len(req.prompts))
             lats.append(lat_b)
             ctrls.append(e.prepared.controller)
@@ -191,11 +201,11 @@ class SweepRunner:
         """Compile-ahead: run once on zero inputs of the batch's shapes.
         Shapes (not values) determine the program, so the real batch then
         executes warm — compile stays off the request path."""
-        import numpy as np
+        import jax
 
         ctx, lat, ctrl = self._inputs(entries, zeros=True)
         imgs, _ = self._run(ctx, lat, ctrl, guidance=1.0)
-        np.asarray(imgs)
+        jax.device_get(imgs)
 
     def _run(self, ctx, lat, ctrl, guidance: float):
         from ..parallel import sweep
@@ -207,15 +217,22 @@ class SweepRunner:
         return imgs, lats
 
     def __call__(self, entries, guidance: float):
-        import numpy as np
+        # d2h via jax.device_get (never np.asarray): the whole call runs
+        # transfer-guard-clean — every h2d is explicitly staged upstream
+        # (tokens, schedule tables, guidance), and the two d2h fetches here
+        # are the only host landings. tests/test_serve.py executes a steady-
+        # state batch under jax.transfer_guard("disallow") to pin it.
+        import jax
 
         ctx, lat, ctrl = self._inputs(entries)
         imgs, lats = self._run(ctx, lat, ctrl, guidance)
         if self.validate:
             from ..engine.sampler import lane_finite
 
-            self.last_lane_finite = lane_finite(lats)
-        return np.asarray(imgs)
+            # Fetched eagerly so the engine's per-lane bool() check reads
+            # host memory, not an implicit per-lane device sync.
+            self.last_lane_finite = jax.device_get(lane_finite(lats))
+        return jax.device_get(imgs)
 
 
 def default_runner_factory(pipe, progress: bool = False,

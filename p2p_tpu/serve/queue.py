@@ -16,7 +16,7 @@ that have not yet dispatched.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from ..obs import metrics as obs_metrics
 from .request import PreparedRequest

@@ -46,6 +46,32 @@ EXPECTED_KEYS = {
 }
 
 
+def test_rehearsal_schema_unchanged_by_static_analysis_pr():
+    """ISSUE 5 is a static-analysis PR: it adds a quality-gate check, NOT a
+    bench block — the rehearsal schema must stay exactly the PR-4 set.
+    A future PR that grows the schema updates this frozen copy (and
+    EXPECTED_KEYS, and bench._BLOCK_KEYS) in the same diff, deliberately."""
+    assert EXPECTED_KEYS == {
+        "metric", "value", "unit", "vs_baseline", "variant", "platform",
+        "single_group_imgs_per_s",
+        "batched_2groups_imgs_per_s", "batched_4groups_imgs_per_s",
+        "batched_8groups_imgs_per_s",
+        "batched_4groups_gate05_imgs_per_s", "gate_step", "gate_window_end",
+        "phase1_ms_per_step", "phase2_ms_per_step", "phase2_unet_batch",
+        "dpm20_imgs_per_s", "dpm20_batched_8groups_imgs_per_s",
+        "dpm20_batched_4groups_imgs_per_s",
+        "reweight_eqsweep_4groups_imgs_per_s",
+        "refine_localblend_imgs_per_s",
+        "ldm256_8prompt_imgs_per_s",
+        "serve", "obs", "resilience",
+        "nullinv_s_per_image",
+    }
+    bench = _import_bench()
+    assert bench._BLOCK_KEYS == ("gsweep", "gate", "dpm", "dpm_batched",
+                                 "reweight", "refine_blend", "ldm256",
+                                 "serve", "obs", "resilience", "nullinv")
+
+
 def _import_bench():
     import importlib.util
     spec = importlib.util.spec_from_file_location(

@@ -516,6 +516,80 @@ def test_serve_generation_requests_match_direct(tiny_pipe):
         assert d.max() <= 1, f"g{i} diverged from direct path: {d.max()}"
 
 
+def test_serve_runner_accepts_64bit_seed(tiny_pipe):
+    """Seeds outside int32 range predate the explicit staging (PRNGKey
+    folds 64-bit ints natively): the staged path must fall back rather
+    than overflow at np.int32."""
+    from p2p_tpu.serve.programs import SweepRunner
+    from p2p_tpu.serve.queue import Entry
+
+    req = Request(request_id="big", prompt="a cat", steps=2, seed=2**31)
+    prep = prepare(req, tiny_pipe)
+    runner = SweepRunner(tiny_pipe, prep.compile_key, 1)
+    ctx, lat, ctrl = runner._inputs([Entry(prepared=prep, arrival_ms=0.0)])
+    assert lat.shape[0] == 1 and ctrl is None
+    # And the small-seed staged path still derives the identical key.
+    import jax
+
+    assert np.array_equal(
+        np.asarray(jax.random.PRNGKey(7)),
+        np.asarray(jax.random.PRNGKey(
+            jax.device_put(np.int32(7)))))
+
+
+def test_serve_dispatch_is_transfer_guard_clean(tiny_pipe):
+    """No *implicit* host transfers per dispatched batch — the dynamic
+    mirror of the static hot-scan contract (`p2p_tpu/analysis/contracts.py`
+    ``hot-scan-callbacks``; docs/STATIC_ANALYSIS.md). Every h2d in the
+    dispatch path is explicitly staged (token ids via device_put, schedule
+    tables cached on device, guidance + seeds staged as numpy scalars) and
+    every d2h is an explicit device_get, so a steady-state batch executes
+    under ``jax.transfer_guard("disallow")`` — which turns any regression
+    (e.g. a per-batch jnp.asarray of host data) into a loud XlaRuntimeError
+    instead of a silent per-batch device sync. Builds/warms run unguarded:
+    first-touch staging and compile are *supposed* to transfer."""
+    import jax
+
+    from p2p_tpu.serve.programs import default_runner_factory
+
+    base = default_runner_factory(tiny_pipe, validate=True)
+    guarded_batches = []
+
+    class GuardedRunner:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def warm(self, entries):
+            self._inner.warm(entries)   # staging/compile may transfer
+
+        @property
+        def last_lane_finite(self):
+            return self._inner.last_lane_finite
+
+        def __call__(self, entries, guidance):
+            with jax.transfer_guard("disallow"):
+                out = self._inner(entries, guidance)
+            guarded_batches.append(len(entries))
+            return out
+
+    def factory(compile_key, bucket):
+        return GuardedRunner(base(compile_key, bucket))
+
+    def req(i, arrival):
+        return Request(request_id=f"tg{i}", prompt="a cat riding a bike",
+                       target="a dog riding a bike", mode="replace",
+                       steps=2, seed=50 + i, arrival_ms=arrival)
+
+    # Two dispatched batches (arrival gap > max_wait) over one warm program.
+    reqs = [req(0, 0.0), req(1, 0.0), req(2, 100.0)]
+    recs = list(serve_forever(tiny_pipe, reqs, max_batch=2, max_wait_ms=5.0,
+                              prewarm=[req(9, 0.0)], runner_factory=factory))
+    by = _by_status(recs)
+    assert len(by["ok"]) == 3, [r for r in recs if r["status"] != "ok"]
+    assert len(guarded_batches) >= 2   # every dispatch ran under the guard
+    assert all(isinstance(r["images"], np.ndarray) for r in by["ok"])
+
+
 # ---------------------------------------------------------------------------
 # CLI subcommand
 # ---------------------------------------------------------------------------

@@ -1,0 +1,139 @@
+"""jaxcheck — the repo's static analyzer (docs/STATIC_ANALYSIS.md).
+
+Two passes over the stack, one exit code:
+
+    python tools/jaxcheck.py                  # both passes, full report
+    python tools/jaxcheck.py --ast-only       # milliseconds: lints only
+    python tools/jaxcheck.py --json out.json  # structured report for CI
+    python tools/jaxcheck.py --fix            # mechanical fixes in place
+    python tools/jaxcheck.py --update-baseline  # accept current findings
+    python tools/jaxcheck.py p2p_tpu/serve    # narrow the lint targets
+
+Exit codes: 0 = clean (new findings: none; contracts: all hold),
+1 = violations, 2 = usage error. ``p2p-tpu check --static`` and the
+``static_analysis`` check in tools/quality_gate.py run the same passes
+through ``p2p_tpu.analysis``.
+
+``--fix`` is best-effort and mechanical only (dead-import removal,
+suppression-comment normalization): it re-lints after rewriting and
+refuses any rewrite that would introduce a finding. Semantic findings
+(traced branches, host syncs, mutable defaults) always need a human.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# AST-only runs must stay jax-free and instant; the contract pass forces
+# CPU before its first jax import (same scrub as the test conftest).
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="lint targets (files/dirs, default: the package + "
+                         "tool drivers)")
+    ap.add_argument("--ast-only", action="store_true",
+                    help="skip the traced-program contract pass (no jax "
+                         "import; milliseconds)")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="baseline file (default: tools/"
+                         "jaxcheck_baseline.json; '' disables)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to accept every current "
+                         "(unsuppressed) AST finding")
+    ap.add_argument("--json", default=None, metavar="FILE",
+                    help="also write the structured report here")
+    ap.add_argument("--fix", action="store_true",
+                    help="apply mechanical fixes (unused imports, "
+                         "suppression formatting) to the lint targets, "
+                         "then re-run")
+    ap.add_argument("--buckets", default="1,2,4,8",
+                    help="serve lane buckets the contract pass traces "
+                         "(comma list; fewer = faster)")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print passing checks and non-new findings")
+    args = ap.parse_args(argv)
+
+    if args.update_baseline and args.baseline == "":
+        # '' means "no baseline in use" — rewriting the committed default
+        # from a de-baselined run would be the opposite of what was asked.
+        ap.error("--update-baseline conflicts with --baseline '' "
+                 "(baselining disabled); name the file to write")
+
+    if not args.ast_only:
+        # The contract pass imports jax: pin the deterministic CPU backend
+        # first (the passes are structure checks, never device work).
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from p2p_tpu.analysis import report as report_mod
+
+    paths = args.paths or None
+
+    if args.fix:
+        from p2p_tpu.analysis import fixes
+        from p2p_tpu.analysis.astlint import iter_python_files
+
+        targets = [p if os.path.isabs(p) else os.path.join(_REPO, p)
+                   for p in (args.paths
+                             or report_mod.DEFAULT_LINT_PATHS)]
+        gone = [t for t in targets if not os.path.exists(t)]
+        if gone:
+            ap.error(f"--fix target(s) do not exist: {gone}")
+        changed = 0
+        for path in iter_python_files(targets):
+            res = fixes.fix_file(path, repo_root=_REPO)
+            if res.get("aborted"):
+                print(f"fix skipped {res['path']}: {res['aborted']}")
+            elif res["changed"]:
+                changed += 1
+                print(f"fixed {res['path']}: "
+                      f"{res['unused_imports_removed']} import(s) removed, "
+                      f"{res['suppressions_normalized']} suppression(s) "
+                      "normalized")
+        print(f"--fix rewrote {changed} file(s)")
+
+    try:
+        buckets = tuple(int(b) for b in args.buckets.split(",") if b)
+    except ValueError:
+        ap.error(f"--buckets expects a comma list of ints, "
+                 f"got {args.buckets!r}")
+
+    try:
+        report = report_mod.run_all(paths, baseline_path=args.baseline,
+                                    ast_only=args.ast_only, buckets=buckets)
+    except FileNotFoundError as e:
+        ap.error(str(e))   # a typo'd target is a usage error (exit 2)
+
+    if args.update_baseline:
+        from p2p_tpu.analysis.findings import save_baseline
+
+        baseline_path = (args.baseline if args.baseline is not None
+                         else os.path.join(_REPO,
+                                           report_mod.DEFAULT_BASELINE))
+        save_baseline(baseline_path, report["ast"]["findings"])
+        print(f"baseline updated: {baseline_path} "
+              f"({report['ast']['summary']['new']} finding(s) accepted)")
+        # Re-baseline the in-memory report so the exit code reflects the
+        # file just written.
+        report = report_mod.run_all(paths, baseline_path=baseline_path,
+                                    ast_only=args.ast_only,
+                                    buckets=buckets)
+
+    print(report_mod.render_text(report, verbose=args.verbose))
+    if args.json:
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(report_mod.to_json_dict(report), f, indent=1)
+        print(f"wrote {args.json}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

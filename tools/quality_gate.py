@@ -193,6 +193,32 @@ def _timed(run, metrics):
     return time.perf_counter() - t0
 
 
+def _static_analysis():
+    """The jaxcheck report (ISSUE 5): both analyzer passes — AST lints
+    against the committed baseline, traced-program contracts (no f64, no
+    hot-scan callbacks, phase-2 footprint, donation-as-declared) and the
+    compile-key completeness sweep over the full Request schema. The gate
+    fails on any NEW lint finding (suppressed/baselined don't count) or
+    any contract/field violation — the same verdict ``python
+    tools/jaxcheck.py`` exits on. One bucket keeps the in-gate run fast;
+    the bucket axis is swept by the analyzer's own tests."""
+    from p2p_tpu.analysis import report as report_mod
+
+    report = report_mod.run_all(buckets=(1,))
+    new = report["ast"]["summary"]["new"]
+    contract_fails = [r for r in report["contracts"]["results"] if not r.ok]
+    key_fails = [v for v in report["compile_key"]["fields"] if not v.ok]
+    detail = []
+    for f in report["ast"]["findings"]:
+        if f.is_new:
+            detail.append("  " + f.format())
+    detail += ["  " + r.format() for r in contract_fails]
+    detail += ["  " + v.format() for v in key_fails]
+    return (report["ok"], new, len(report["contracts"]["results"]),
+            len(contract_fails), len(report["compile_key"]["fields"]),
+            len(key_fails), detail)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--only", default=None,
@@ -218,6 +244,10 @@ def main(argv=None) -> int:
                     help="skip the chaos/crash-replay resilience check "
                          "(ISSUE 4; ~35s: it serves the standard trace "
                          "four times)")
+    ap.add_argument("--skip-static", action="store_true",
+                    help="skip the static-analysis check (ISSUE 5; ~60s: "
+                         "AST lints + traced-program contracts + the "
+                         "compile-key completeness sweep)")
     ap.add_argument("--obs-overhead", type=float, default=1.5,
                     help="max fractional wall-clock overhead of the "
                          "metrics-enabled sampler vs disabled (ISSUE 3 "
@@ -233,11 +263,12 @@ def main(argv=None) -> int:
     only = set(args.only.split(",")) if args.only else None
     if only:
         unknown = only - set(cases) - {"phase_gate", "serve_parity",
-                                       "obs_overhead", "fault_drill"}
+                                       "obs_overhead", "fault_drill",
+                                       "static_analysis"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
                      f"valid: {', '.join(cases)}, phase_gate, serve_parity, "
-                     f"obs_overhead, fault_drill")
+                     f"obs_overhead, fault_drill, static_analysis")
 
     drifted = []
     for name, fn in cases.items():
@@ -307,6 +338,18 @@ def main(argv=None) -> int:
                   f"{'ok' if ok else 'DRIFT'}")
             if not ok:
                 drifted.append("fault_drill")
+
+    if not args.skip_static and (only is None or "static_analysis" in only):
+        ok, new, n_contracts, bad_contracts, n_fields, bad_fields, detail = \
+            _static_analysis()
+        print(f"{'static_analysis':16s} {new} new lint finding(s), "
+              f"{bad_contracts}/{n_contracts} contract failure(s), "
+              f"{bad_fields}/{n_fields} compile-key violation(s) "
+              f"{'ok' if ok else 'DRIFT'}")
+        for line in detail:
+            print(line)
+        if not ok:
+            drifted.append("static_analysis")
 
     if drifted:
         print(f"QUALITY GATE FAILED: {', '.join(drifted)} "
