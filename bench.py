@@ -890,6 +890,72 @@ def _measure(preset):
                 "prewarm_ms": round(summary["prewarm_ms"], 1),
             }
 
+            # Phase-disaggregated A/B (ISSUE 6): the SAME gate-mix trace
+            # through the single-pool baseline (phase_pools=False — the
+            # pre-disaggregation engine) and the two-pool engine, each
+            # after a warmup pass so both sides run warm programs. The
+            # sub-record captures the architectural facts (hand-off rate,
+            # per-phase occupancy, phase-2 pack width at the doubled
+            # equal-footprint cap) plus the measured throughput/p95
+            # comparison. On a linear-batch-cost CPU host the wall-clock
+            # ratio sits near 1.0 (equal total compute repacked); the
+            # width-restoration win — phase 2 running 2x the lanes at the
+            # CFG phase's device batch — is what the next chip window
+            # quantifies from these same keys.
+            mix = loadgen.parse_gate_mix("0.5:3,off:1")
+            n2 = 12 if full else 24
+            trace2 = loadgen.generate_trace(
+                n2, mode="poisson", rate_per_s=50.0, seed=1,
+                steps=num_steps, gate_mix=mix)
+            reqs2 = [Request.from_dict(d) for d in trace2]
+            pre2 = ([r for r in reqs2 if r.gate is not None][:1]
+                    + [r for r in reqs2 if r.gate is None][:1])
+
+            def run_ab(pools):
+                s = None
+                ok = 0
+                for rec in serve_forever(pipe,
+                                         [Request.from_dict(d)
+                                          for d in trace2],
+                                         max_batch=4, max_wait_ms=100.0,
+                                         prewarm=pre2, phase_pools=pools):
+                    if rec["status"] == "ok":
+                        ok += 1
+                    elif rec["status"] == "summary":
+                        s = rec
+                if ok != n2:
+                    raise RuntimeError(
+                        f"serve A/B ({'two' if pools else 'single'}-pool) "
+                        f"served {ok}/{n2} (counts: {s and s['counts']})")
+                return s
+
+            run_ab(False)                     # warm both paths' programs
+            run_ab(True)
+            s_single = run_ab(False)
+            s_two = run_ab(True)
+            ph = s_two["phases"]
+            makespan_s = s_two["makespan_ms"] / 1000.0
+            extras["serve"]["phases"] = {
+                "n_requests": n2,
+                "handoffs": ph["handoffs"],
+                "handoffs_per_s": round(ph["handoffs"] / makespan_s, 3),
+                "phase1_batches": ph["phase1"]["batches"],
+                "phase2_batches": ph["phase2"]["batches"],
+                "phase1_mean_occupancy": round(
+                    ph["phase1"]["mean_occupancy"], 3),
+                "phase2_mean_occupancy": round(
+                    ph["phase2"]["mean_occupancy"], 3),
+                "phase2_pack_p50": ph["phase2"]["pack_p50"],
+                "phase2_max_batch": ph["phase2_max_batch"],
+                "single_pool_makespan_ms": round(
+                    s_single["makespan_ms"], 1),
+                "two_pool_makespan_ms": round(s_two["makespan_ms"], 1),
+                "throughput_ratio": round(
+                    s_single["makespan_ms"] / s_two["makespan_ms"], 3),
+                "single_pool_p95_ms": round(s_single["p95_ms"], 2),
+                "two_pool_p95_ms": round(s_two["p95_ms"], 2),
+            }
+
         # Telemetry-overhead block (ISSUE 3): the same headline single-group
         # edit run with the obs instrumentation enabled (phase-tagged step
         # callbacks traced in, host collector installed) vs disabled, so

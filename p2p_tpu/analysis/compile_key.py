@@ -158,6 +158,142 @@ def _program_fingerprint(pipe, prep) -> str:
     return hashlib.sha256(str(jaxpr).encode()).hexdigest()
 
 
+#: The phase-key sweep's base: the same request GATED (steps=4 so
+#: gate=0.5 → step 2 leaves both phases ≥ 2 steps) — the disaggregated
+#: pool keys only exist for gated requests. Field variants that need a
+#: different value under this base override VARIANTS here.
+PHASE_EXTRA = {"gate": 0.5, "steps": 4}
+PHASE_VARIANT_OVERRIDES: Dict[str, Tuple[object, dict]] = {
+    # The gated base pins steps=4 and gate=0.5, so the plain variants
+    # (steps=4, gate=0.5) would be no-ops; these move them instead:
+    # steps 4→5 changes both pool scan lengths, gate 0.5→0.75 moves the
+    # boundary (phase-1 grows, phase-2 shrinks) — THE hand-off regression
+    # this sweep exists for: a gate change that altered a phase program
+    # but not its key would poison the pool cache.
+    "steps": (5, {}),
+    "gate": (0.75, {}),
+}
+
+
+def _phase_fingerprints(pipe, prep) -> Tuple[str, str]:
+    """Hashes of the two POOL programs this gated prepared request would
+    compile (bucket 1). Mirrors ``serve.programs.Phase1Runner`` /
+    ``Phase2Runner``: same input construction, same jitted entries, same
+    static arguments — including the phase-2 controller reduction."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine.sampler import (encode_prompts, init_latent,
+                                  phase2_controller)
+    from ..models.config import unet_layout
+    from ..ops import schedulers as sched_mod
+    from ..parallel.sweep import _sweep_phase1_jit, _sweep_phase2_jit
+    from ..serve.handoff import carry_template
+
+    req = prep.request
+    cfg = pipe.config
+    layout = unet_layout(cfg.unet)
+    schedule = sched_mod.schedule_from_config(req.steps, cfg.scheduler,
+                                              kind=req.scheduler)
+    cond = encode_prompts(pipe, list(req.prompts))
+    uncond = encode_prompts(pipe,
+                            [req.negative_prompt or ""] * len(req.prompts))
+    ctx = jnp.concatenate([uncond, cond], axis=0)[None]
+    _, lat = init_latent(None, pipe.latent_shape,
+                         jax.random.PRNGKey(req.seed), len(req.prompts))
+    lat = lat[None]
+    ctrl = (None if prep.controller is None else jax.tree_util.tree_map(
+        lambda x: jnp.stack([x]), prep.controller))
+    gs = jnp.float32(req.guidance)
+
+    def run1(up, ctx, lat, ctrl, gs):
+        return _sweep_phase1_jit(up, cfg, layout, schedule, req.scheduler,
+                                 ctx, lat, ctrl, gs, progress=False,
+                                 gate=prep.gate_step, metrics=False)
+
+    fp1 = jax.make_jaxpr(run1)(pipe.unet_params, ctx, lat, ctrl, gs)
+
+    # carry_template returns the hand-off unit {"carry", "ctx"}; the jit
+    # takes the sampler carry and the cond context as separate arguments
+    # (mirroring Phase2Runner's unpack).
+    carry = jax.tree_util.tree_map(lambda x: jnp.stack([x]),
+                                   carry_template(pipe, prep)["carry"])
+    p2 = phase2_controller(prep.controller)
+    p2_g = (None if p2 is None else jax.tree_util.tree_map(
+        lambda x: jnp.stack([x]), p2))
+
+    def run2(up, vp, ctx_c, carry, ctrl, gs):
+        return _sweep_phase2_jit(up, vp, cfg, layout, schedule,
+                                 req.scheduler, ctx_c, carry, ctrl, gs,
+                                 progress=False, gate=prep.gate_step,
+                                 metrics=False)
+
+    fp2 = jax.make_jaxpr(run2)(pipe.unet_params, pipe.vae_params,
+                               cond[None], carry, p2_g, gs)
+    return (hashlib.sha256(str(fp1).encode()).hexdigest(),
+            hashlib.sha256(str(fp2).encode()).hexdigest())
+
+
+def check_phase_keys(pipe=None,
+                     key1_fn: Optional[Callable] = None,
+                     key2_fn: Optional[Callable] = None,
+                     fields: Optional[List[str]] = None
+                     ) -> List[FieldVerdict]:
+    """The completeness sweep over the SPLIT per-phase pool keys: every
+    Request field is perturbed against a *gated* base, the two pool
+    programs each variant would compile are traced, and both directions
+    must hold per field per pool — a field that changes a pool program
+    must change that pool's compile key (else: pool-cache poisoning, the
+    hand-off serving requests a mismatched program), and one that doesn't
+    must not (else: retracing churn and lost phase-2 packing). Verdicts
+    come back as ``<field>@phase1`` / ``<field>@phase2``.
+
+    ``key1_fn``/``key2_fn`` override the keys under test (the regression
+    hook: masking the gate from ``phase2_key`` must be caught as
+    poisoning for exactly the ``gate`` field)."""
+    from ..serve.request import Request, prepare
+
+    if pipe is None:
+        from .contracts import tiny_pipeline
+
+        pipe = tiny_pipeline()
+    key1_fn = key1_fn or (lambda prep: prep.phase1_key)
+    key2_fn = key2_fn or (lambda prep: prep.phase2_key)
+
+    declared = {f.name for f in dataclasses.fields(Request)}
+    missing = declared - set(VARIANTS)
+    if missing:
+        raise ValueError(
+            f"Request field(s) {sorted(missing)} have no compile-key sweep "
+            "variant: add them to analysis.compile_key.VARIANTS so the "
+            "completeness check covers the new schema")
+
+    todo = fields if fields is not None else sorted(VARIANTS)
+    fp_cache: Dict[Tuple, Tuple[str, str]] = {}
+
+    def fingerprint(overrides: dict):
+        prep = prepare(_request({**PHASE_EXTRA, **overrides}), pipe)
+        assert prep.gated, ("phase-key sweep base must stay gated; "
+                            f"overrides {overrides} ungated it")
+        cache_key = tuple(sorted(overrides.items()))
+        if cache_key not in fp_cache:
+            fp_cache[cache_key] = _phase_fingerprints(pipe, prep)
+        return fp_cache[cache_key], key1_fn(prep), key2_fn(prep)
+
+    verdicts = []
+    for field in todo:
+        variant, extra = PHASE_VARIANT_OVERRIDES.get(field, VARIANTS[field])
+        (base1, base2), bk1, bk2 = fingerprint(dict(extra))
+        (var1, var2), vk1, vk2 = fingerprint({**extra, field: variant})
+        verdicts.append(FieldVerdict(field=f"{field}@phase1",
+                                     program_changed=var1 != base1,
+                                     key_changed=vk1 != bk1))
+        verdicts.append(FieldVerdict(field=f"{field}@phase2",
+                                     program_changed=var2 != base2,
+                                     key_changed=vk2 != bk2))
+    return verdicts
+
+
 def check_compile_key(pipe=None,
                       key_fn: Optional[Callable] = None,
                       fields: Optional[List[str]] = None

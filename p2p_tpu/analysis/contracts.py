@@ -28,9 +28,13 @@ contracts that hand-written review keeps re-checking:
 
 Programs traced (:func:`canonical_programs`): text2image ungated + gated
 (phase 1/2), serve batch programs across every lane bucket (1/2/4/8, the
-``BUCKET_SIZES`` padding contract), and the two inversion programs. The
-tiny pipeline is the same construction the golden tests use (random
-weights; contracts are shape/structure properties, weights never matter).
+``BUCKET_SIZES`` padding contract), the disaggregated phase-1/phase-2
+POOL programs at the same buckets (phase-disaggregated continuous
+batching — ``phase2-footprint`` pairs each phase-2 pool program with its
+phase-1 twin, since each pool compiles a single scan), and the two
+inversion programs. The tiny pipeline is the same construction the golden
+tests use (random weights; contracts are shape/structure properties,
+weights never matter).
 """
 
 from __future__ import annotations
@@ -172,6 +176,89 @@ def _trace_sweep(pipe, ctrl, bucket, gate, metrics):
                                lat_g, ctrl_g, gs)
 
 
+def _zero_carry(pipe, ctrl):
+    """A zero-valued per-group PhaseCarry with the shapes the phase-1 pool
+    program produces for this controller — the phase-2 pool trace input."""
+    import jax.numpy as jnp
+
+    from ..controllers.base import init_store_state
+    from ..engine.sampler import PhaseCarry
+    from ..models.config import unet_layout
+    from ..models.unet import init_attn_cache
+    from ..ops import schedulers as sched_mod
+
+    layout = unet_layout(pipe.config.unet)
+    b = len(PROMPTS)
+    lat = jnp.zeros((b,) + pipe.latent_shape)
+    state = (init_store_state(layout, b)
+             if (ctrl is not None and ctrl.needs_store) else ())
+    return PhaseCarry(
+        latents=lat, resid=jnp.zeros_like(lat),
+        cache=init_attn_cache(layout, b, dtype=lat.dtype),
+        ms=sched_mod.init_multistep_state("ddim", lat.shape, lat.dtype),
+        state=state)
+
+
+def _trace_sweep_phase1(pipe, ctrl, bucket, gate, metrics):
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.config import unet_layout
+    from ..ops import schedulers as sched_mod
+    from ..parallel.sweep import _sweep_phase1_jit
+
+    cfg = pipe.config
+    layout = unet_layout(cfg.unet)
+    schedule = sched_mod.schedule_from_config(STEPS, cfg.scheduler,
+                                              kind="ddim")
+    ctx, lats, gs = _scan_inputs(pipe)
+    ctx_g = jnp.broadcast_to(ctx[None], (bucket,) + ctx.shape)
+    lat_g = jnp.broadcast_to(lats[None], (bucket,) + lats.shape)
+    ctrl_g = (None if ctrl is None else jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (bucket,) + x.shape), ctrl))
+
+    def run(up, ctx_g, lat_g, ctrl_g, gs):
+        return _sweep_phase1_jit(up, cfg, layout, schedule, "ddim", ctx_g,
+                                 lat_g, ctrl_g, gs, progress=False,
+                                 gate=gate, metrics=metrics)
+
+    return jax.make_jaxpr(run)(pipe.unet_params, ctx_g, lat_g, ctrl_g, gs)
+
+
+def _trace_sweep_phase2(pipe, ctrl, bucket, gate, metrics):
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine.sampler import encode_prompts, phase2_controller
+    from ..models.config import unet_layout
+    from ..ops import schedulers as sched_mod
+    from ..parallel.sweep import _sweep_phase2_jit
+
+    cfg = pipe.config
+    layout = unet_layout(cfg.unet)
+    schedule = sched_mod.schedule_from_config(STEPS, cfg.scheduler,
+                                              kind="ddim")
+    cond = encode_prompts(pipe, list(PROMPTS))
+    carry = _zero_carry(pipe, ctrl)
+    p2 = phase2_controller(ctrl)
+
+    def lead(x):
+        return jnp.broadcast_to(x[None], (bucket,) + x.shape)
+
+    ctx_g = lead(cond)
+    carry_g = jax.tree_util.tree_map(lead, carry)
+    ctrl_g = None if p2 is None else jax.tree_util.tree_map(lead, p2)
+    gs = jnp.float32(7.5)
+
+    def run(up, vp, ctx_g, carry_g, ctrl_g, gs):
+        return _sweep_phase2_jit(up, vp, cfg, layout, schedule, "ddim",
+                                 ctx_g, carry_g, ctrl_g, gs, progress=False,
+                                 gate=gate, metrics=metrics)
+
+    return jax.make_jaxpr(run)(pipe.unet_params, pipe.vae_params, ctx_g,
+                               carry_g, ctrl_g, gs)
+
+
 def _trace_invert(pipe, metrics):
     """The two inversion programs: DDIM forward-invert and the null-text
     optimizer outer scan."""
@@ -228,6 +315,20 @@ def canonical_programs(pipe=None, buckets=(1, 2, 4, 8),
         programs.append(Program(
             f"serve/bucket{g}",
             _trace_sweep(pipe, ctrl, bucket=g, gate=GATE, metrics=metrics),
+            group_batch=b, gate=GATE, metrics=metrics, lead_dims=(g,)))
+    for g in buckets:
+        # The disaggregated pool programs (phase-disaggregated continuous
+        # batching): phase 1 and phase 2 compile separately; the
+        # phase2-footprint contract pairs them by bucket.
+        programs.append(Program(
+            f"serve/phase1-bucket{g}",
+            _trace_sweep_phase1(pipe, ctrl, bucket=g, gate=GATE,
+                                metrics=metrics),
+            group_batch=b, gate=GATE, metrics=metrics, lead_dims=(g,)))
+        programs.append(Program(
+            f"serve/phase2-bucket{g}",
+            _trace_sweep_phase2(pipe, ctrl, bucket=g, gate=GATE,
+                                metrics=metrics),
             group_batch=b, gate=GATE, metrics=metrics, lead_dims=(g,)))
     inv, null = _trace_invert(pipe, metrics=metrics)
     programs.append(Program("invert/ddim", inv, group_batch=1, gate=None,
@@ -289,12 +390,84 @@ def check_hot_scan_callbacks(programs: List[Program]) -> List[ContractResult]:
     return out
 
 
+def _doubled_detector(p: Program):
+    """The CFG-doubled-batch detector for one program: plain ``(2B, ...)``
+    shapes for unbatched programs; explicit ``(G, 2B, ...)`` prefixes plus
+    vmap-folded ``(G·2B, h, w, c)`` conv activations for vmapped serve
+    programs. Only these exact forms count: an unqualified leading-dim
+    match would collide with G·B phase-2 activations whenever G·B == 2B
+    (bucket 2 at B=2)."""
+
+    def doubled(body):
+        shapes = jaxpr_walk.eqn_shapes(body)
+        if not p.lead_dims:
+            return jaxpr_walk.doubled_batch_shapes(shapes, p.group_batch)
+        g = p.lead_dims[0]
+        return (jaxpr_walk.doubled_batch_shapes(
+                    shapes, p.group_batch, lead_dims=p.lead_dims)
+                + jaxpr_walk.folded_batch_shapes(
+                    shapes, g * 2 * p.group_batch))
+
+    return doubled
+
+
+def check_pool_footprint(programs: List[Program]) -> List[ContractResult]:
+    """phase2-footprint for the DISAGGREGATED pool programs: each pool
+    compiles one scan, so the two-phase comparison pairs
+    ``serve/phase1-bucketG`` with ``serve/phase2-bucketG`` — the phase-2
+    pool program must carry no CFG-doubled tensors anywhere in its scan
+    and its scan body must be strictly smaller than its phase-1 twin's."""
+    out = []
+    pool = {p.name: p for p in programs
+            if p.name.startswith("serve/phase")}
+    p1_names = sorted(n for n in pool if n.startswith("serve/phase1-"))
+    for n1 in p1_names:
+        n2 = n1.replace("phase1-", "phase2-")
+        pair_name = n2
+        if n2 not in pool:
+            out.append(ContractResult(
+                "phase2-footprint", pair_name, False,
+                f"phase-1 pool program {n1} has no phase-2 twin"))
+            continue
+        p1, p2 = pool[n1], pool[n2]
+        s1 = jaxpr_walk.top_level_scans(p1.jaxpr)
+        s2 = jaxpr_walk.top_level_scans(p2.jaxpr)
+        if len(s1) != 1 or len(s2) != 1:
+            out.append(ContractResult(
+                "phase2-footprint", pair_name, False,
+                f"pool programs must carry exactly one scan each, found "
+                f"{len(s1)}/{len(s2)}"))
+            continue
+        body1 = jaxpr_walk.scan_body(s1[0])
+        body2 = jaxpr_walk.scan_body(s2[0])
+        d1 = _doubled_detector(p1)(body1)
+        d2 = _doubled_detector(p2)(body2)
+        if not d1:
+            out.append(ContractResult(
+                "phase2-footprint", pair_name, False,
+                "detector vacuous: the phase-1 pool scan carries no "
+                "CFG-doubled batch"))
+            continue
+        ok = not d2 and len(body2) < len(body1)
+        detail = (f"pool scan {len(body2)} eqns < phase1 {len(body1)}, "
+                  f"no 2B tensors" if ok else
+                  (f"phase-2 pool scan still carries 2B tensors: "
+                   f"{sorted(set(d2))[:4]}" if d2 else
+                   f"phase-2 pool scan ({len(body2)} eqns) not smaller "
+                   f"than phase-1 ({len(body1)})"))
+        out.append(ContractResult("phase2-footprint", pair_name, ok, detail))
+    return out
+
+
 def check_phase2_footprint(programs: List[Program]) -> List[ContractResult]:
     """The generalized ISSUE 1 proof: phase 2 carries no CFG-doubled batch
-    and is strictly smaller than phase 1 — on every gated surface."""
+    and is strictly smaller than phase 1 — on every gated surface. The
+    single-program (two-scan) surfaces are checked here; the disaggregated
+    pool programs pair up in :func:`check_pool_footprint`."""
     out = []
     for p in programs:
-        if p.gate is None or p.name.startswith("invert/"):
+        if p.gate is None or p.name.startswith("invert/") \
+                or p.name.startswith("serve/phase"):
             continue
         scans = jaxpr_walk.top_level_scans(p.jaxpr)
         if len(scans) != 2:
@@ -305,25 +478,7 @@ def check_phase2_footprint(programs: List[Program]) -> List[ContractResult]:
             continue
         body1 = jaxpr_walk.scan_body(scans[0])
         body2 = jaxpr_walk.scan_body(scans[1])
-
-        # Inside a vmapped serve program the uncond half can appear two
-        # ways: batched tensors with an explicit (G, 2B, ...) prefix, or
-        # conv activations where vmap folded the group axis into the batch
-        # axis — (G·2B, h, w, c). The unbatched programs use the plain
-        # (2B, ...) detector. Only these exact forms count: an unqualified
-        # leading-dim match would collide with G·B phase-2 activations
-        # whenever G·B == 2B (bucket 2 at B=2).
-        def doubled(body):
-            shapes = jaxpr_walk.eqn_shapes(body)
-            if not p.lead_dims:
-                return jaxpr_walk.doubled_batch_shapes(shapes,
-                                                       p.group_batch)
-            g = p.lead_dims[0]
-            return (jaxpr_walk.doubled_batch_shapes(
-                        shapes, p.group_batch, lead_dims=p.lead_dims)
-                    + jaxpr_walk.folded_batch_shapes(
-                        shapes, g * 2 * p.group_batch))
-
+        doubled = _doubled_detector(p)
         d1, d2 = doubled(body1), doubled(body2)
         if not d1:
             out.append(ContractResult(
@@ -402,5 +557,6 @@ def run_contracts(pipe=None, buckets=(1, 2, 4, 8)) -> List[ContractResult]:
     results += check_hot_scan_callbacks(plain)
     results += check_hot_scan_callbacks(instrumented)
     results += check_phase2_footprint(plain)
+    results += check_pool_footprint(plain)
     results += check_donation(pipe)
     return results

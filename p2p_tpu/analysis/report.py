@@ -81,6 +81,10 @@ def run_contract_pass(pipe=None, buckets=(1, 2, 4, 8),
         pipe = contracts_mod.tiny_pipeline()
     results = contracts_mod.run_contracts(pipe, buckets=buckets)
     verdicts = ck_mod.check_compile_key(pipe, fields=compile_key_fields)
+    # The split per-phase pool keys sweep the same schema against a gated
+    # base (verdicts land as <field>@phase1 / <field>@phase2): the
+    # hand-off's cache-poisoning guard rides the same report gate.
+    verdicts += ck_mod.check_phase_keys(pipe, fields=compile_key_fields)
     return {
         "contracts": {"results": results,
                       "ok": all(r.ok for r in results)},

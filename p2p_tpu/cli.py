@@ -535,7 +535,9 @@ def cmd_serve(args) -> int:
                     journal=journal, chaos=chaos,
                     watchdog_ms=args.watchdog_ms,
                     validate_outputs=args.validate_outputs,
-                    degrade=degrade):
+                    degrade=degrade,
+                    phase_pools=not args.single_pool,
+                    phase2_max_batch=args.phase2_max_batch):
                 emit(rec)
     finally:
         if journal is not None:
@@ -764,6 +766,18 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--max-wait-ms", type=float, default=50.0,
                    help="flush a partial bucket after its oldest request "
                         "has waited this long")
+    s.add_argument("--phase2-max-batch", type=int, default=None,
+                   choices=(1, 2, 4, 8), metavar="N",
+                   help="lane-bucket cap of the phase-2 pool (gated "
+                        "requests past the hand-off; default: one fixed "
+                        "bucket above --max-batch — phase-2 lanes carry no "
+                        "CFG uncond half, so 2x the lanes fit the same "
+                        "peak footprint)")
+    s.add_argument("--single-pool", action="store_true",
+                   help="disable phase-disaggregated continuous batching: "
+                        "gated requests run their monolithic program in "
+                        "one pool (the pre-disaggregation engine; the A/B "
+                        "baseline bench.py compares against)")
     s.add_argument("--queue-cap", type=int, default=64,
                    help="admission bound on outstanding requests; beyond "
                         "it, requests are rejected with a reason "

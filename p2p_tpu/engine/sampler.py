@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import TYPE_CHECKING, Any, Optional, Tuple
+from typing import TYPE_CHECKING, Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -207,6 +207,241 @@ def warn_gate_truncation(gate_step: int, num_scan: int,
             "phase 1 only", stacklevel=3)
 
 
+class PhaseCarry(NamedTuple):
+    """The phase-1 → phase-2 hand-off, packaged as ONE pytree.
+
+    This is the unit of transfer between the serve layer's two program
+    pools (phase-disaggregated continuous batching): everything a phase-2
+    program needs to continue a trajectory whose CFG/controller phase
+    already ran. The treedef is *pinned* per compiled program —
+    :func:`carry_spec` renders it (structure + leaf shapes/dtypes) and the
+    hand-off path validates it, so a carry can never silently feed a
+    mismatched phase-2 program. All leaves are plain arrays, so a carry
+    round-trips through host memory (``jax.device_get`` → ``.npz`` → device)
+    for the journal's crash-replay spill.
+    """
+
+    latents: jax.Array    # (B, h, w, c) latents after the last phase-1 step
+    resid: jax.Array      # (B, h, w, c) CFG residual ε_text − ε_uncond there
+    cache: Tuple          # AttnCache: every cross-attn site's cached output
+    ms: Any               # multistep scheduler state (None for DDIM)
+    state: Tuple          # frozen phase-1 StoreState (LocalBlend source)
+
+
+def carry_spec(carry: PhaseCarry) -> str:
+    """The pinned treedef of a hand-off carry: pytree structure plus every
+    leaf's shape/dtype. Two carries with equal specs are exchangeable
+    inputs of the same phase-2 program; the hand-off path hard-errors on a
+    mismatch instead of letting XLA fail (or worse, retrace) later."""
+    leaves, treedef = jax.tree_util.tree_flatten(carry)
+    leaf_sig = ",".join(f"{tuple(x.shape)}/{x.dtype}" for x in leaves)
+    return f"{treedef}|{leaf_sig}"
+
+
+def phase2_controller(controller: Optional[Controller]
+                      ) -> Optional[Controller]:
+    """The slice of a controller the phase-2 program actually consumes.
+
+    Past the gate the U-Net runs with ``controller=None`` (attention hooks
+    are structurally gone); only the latent-space step callback survives —
+    SpatialReplace injection and LocalBlend compositing against the frozen
+    phase-1 store. Attention-edit parameters and the store flag are
+    dropped, so e.g. a ``replace`` and a ``refine`` edit reduce to the SAME
+    phase-2 controller (``None``) and their phase-2 lanes can share one
+    compiled pool program — the serve layer's phase-2 compile key is
+    derived from this reduction. For controllers the reduction maps to
+    ``None`` the emitted ops are identical to passing the full controller
+    (both step-callback branches are static no-ops), which is what keeps
+    the pooled program bitwise-equal to the monolithic gated scan."""
+    if controller is None:
+        return None
+    if controller.blend is None and controller.spatial_stop_inject is None:
+        return None
+    return controller.replace(edit=None, store=False)
+
+
+def _make_ms_step(schedule: sched_mod.DiffusionSchedule, scheduler_kind: str):
+    use_plms = scheduler_kind == "plms"
+    use_dpm = scheduler_kind == "dpm"
+
+    def ms_step(ms, eps, t, latents):
+        if use_plms:
+            return sched_mod.plms_step(schedule, ms, eps, t, latents)
+        if use_dpm:
+            return sched_mod.dpm_step(schedule, ms, eps, t, latents)
+        return ms, sched_mod.ddim_step(schedule, eps, t, latents)
+
+    return ms_step
+
+
+def _make_phase1_body(
+    unet_params: Any,
+    cfg: PipelineConfig,
+    layout: AttnLayout,
+    schedule: sched_mod.DiffusionSchedule,
+    scheduler_kind: str,
+    context: jax.Array,
+    b: int,
+    controller: Optional[Controller],
+    guidance_scale: jax.Array,
+    uncond_per_step: Optional[jax.Array],
+    emit: bool,
+    progress: bool,
+    sp: Optional["SpConfig"],
+    capture: bool,
+):
+    """The CFG scan body — phase 1 of a gated scan (``capture=True``:
+    carries the AttnCache + CFG residual) or the whole ungated scan
+    (``capture=False``: the exact pre-gate program)."""
+    ms_step = _make_ms_step(schedule, scheduler_kind)
+
+    def body(carry, scan_in):
+        if capture:
+            latents, state, ms, cache, resid = carry
+        else:
+            latents, state, ms = carry
+        step, t = scan_in
+        progress_mod.emit_step(emit, step, phase="phase1", report=progress)
+        ctx = context
+        if uncond_per_step is not None:
+            # Null-text: substitute this step's optimized uncond embedding.
+            # Cast to the sampling dtype — the artifact stores f32 (the
+            # optimizer's dtype), and a f32 leak here would silently promote
+            # the whole CFG context (and the U-Net matmuls) on the bf16 path.
+            u = jax.lax.dynamic_index_in_dim(uncond_per_step, step, 0,
+                                             keepdims=False)
+            ctx = jnp.concatenate([jnp.broadcast_to(u.astype(context.dtype),
+                                                    context[:b].shape),
+                                   context[b:]], axis=0)
+        latent_in = jnp.concatenate([latents] * 2, axis=0)
+        if capture:
+            eps, state, cache = apply_unet(
+                unet_params, cfg.unet, latent_in, t, ctx,
+                layout=layout, controller=controller, state=state, step=step,
+                sp=sp, attn_cache=cache, cache_mode="store")
+        else:
+            eps, state = apply_unet(
+                unet_params, cfg.unet, latent_in, t, ctx,
+                layout=layout, controller=controller, state=state, step=step,
+                sp=sp)
+        eps_uncond, eps_text = eps[:b], eps[b:]
+        if capture:
+            resid = eps_text - eps_uncond
+            eps = eps_uncond + guidance_scale * resid
+        else:
+            eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
+        # v-prediction models (SD-2.1 768-v): convert to ε once per step.
+        # Linear in the model output, so combining CFG first is equivalent.
+        eps = sched_mod.to_epsilon(schedule, eps, t, latents)
+        ms, latents = ms_step(ms, eps, t, latents)
+        latents = apply_step_callback(controller, layout, state, latents,
+                                      step)
+        if capture:
+            return (latents, state, ms, cache, resid), None
+        return (latents, state, ms), None
+
+    return body
+
+
+def _phase1_scan(
+    unet_params: Any,
+    cfg: PipelineConfig,
+    layout: AttnLayout,
+    schedule: sched_mod.DiffusionSchedule,
+    scheduler_kind: str,
+    context: jax.Array,            # (2B, L, D) [uncond; cond]
+    latents: jax.Array,            # (B, h, w, c)
+    controller: Optional[Controller],
+    guidance_scale: jax.Array,
+    *,
+    gate: int,                     # static: first phase-2 scan step
+    progress: bool = False,
+    metrics: bool = False,
+    sp: Optional["SpConfig"] = None,
+) -> PhaseCarry:
+    """Scan steps ``[0, gate)`` with full CFG + controller hooks, capturing
+    every cross-attention output and the CFG residual. Returns the
+    :class:`PhaseCarry` a phase-2 program continues from. Latent math is
+    identical to the ungated body (the capture only adds carry writes), so
+    phase-1 latents match the baseline bitwise."""
+    emit = progress or metrics
+    b = latents.shape[0]
+    state = (init_store_state(layout, b, dtype=jnp.float32)
+             if (controller is not None and controller.needs_store) else ())
+    ms_state = sched_mod.init_multistep_state(scheduler_kind, latents.shape,
+                                              latents.dtype)
+    body = _make_phase1_body(unet_params, cfg, layout, schedule,
+                             scheduler_kind, context, b, controller,
+                             guidance_scale, None, emit, progress, sp,
+                             capture=True)
+    num_scan = schedule.timesteps.shape[0]
+    assert 1 <= gate <= num_scan, (gate, num_scan)
+    steps = jnp.arange(num_scan, dtype=jnp.int32)
+    cache = init_attn_cache(layout, b, dtype=latents.dtype)
+    resid = jnp.zeros_like(latents)
+    (latents, state, ms_state, cache, resid), _ = jax.lax.scan(
+        body, (latents, state, ms_state, cache, resid),
+        (steps[:gate], schedule.timesteps[:gate]))
+    return PhaseCarry(latents=latents, resid=resid, cache=cache,
+                      ms=ms_state, state=state)
+
+
+def _phase2_scan(
+    unet_params: Any,
+    cfg: PipelineConfig,
+    layout: AttnLayout,
+    schedule: sched_mod.DiffusionSchedule,
+    scheduler_kind: str,
+    context_cond: jax.Array,       # (B, L, D) — the uncond half is GONE
+    carry: PhaseCarry,
+    controller: Optional[Controller],
+    guidance_scale: jax.Array,
+    *,
+    gate: int,                     # static: first phase-2 scan step
+    progress: bool = False,
+    metrics: bool = False,
+    sp: Optional["SpConfig"] = None,
+) -> jax.Array:
+    """Scan steps ``[gate, S)`` off a :class:`PhaseCarry`: single-branch
+    U-Net (no uncond batch half), guidance as a fixed extrapolation off the
+    captured residual (SD-Acc), cross-attention served from the cache
+    (TAD). ``controller`` here is the phase-2 slice
+    (:func:`phase2_controller` for pooled serving; the monolithic path
+    passes the full controller — both emit identical ops)."""
+    emit = progress or metrics
+    ms_step = _make_ms_step(schedule, scheduler_kind)
+    cache, resid, state = carry.cache, carry.resid, carry.state
+
+    def body2(c2, scan_in):
+        latents, ms = c2
+        step, t = scan_in
+        progress_mod.emit_step(emit, step, phase="phase2", report=progress)
+        eps_text, _ = apply_unet(
+            unet_params, cfg.unet, latents, t, context_cond,
+            layout=layout, controller=None, state=(), step=step, sp=sp,
+            attn_cache=cache, cache_mode="use")
+        # SD-Acc-style fixed extrapolation: CFG's uncond branch is gone;
+        # ε = ε_text + (g−1)·(ε_text − ε_uncond)|_gate reuses the captured
+        # last-phase-1 residual as the guidance direction.
+        eps = eps_text + (guidance_scale - 1.0) * resid
+        eps = sched_mod.to_epsilon(schedule, eps, t, latents)
+        ms, latents = ms_step(ms, eps, t, latents)
+        # Latent-space controller effects (LocalBlend compositing /
+        # SpatialReplace injection) continue against the frozen phase-1
+        # store; attention hooks are structurally gone.
+        latents = apply_step_callback(controller, layout, state, latents,
+                                      step)
+        return (latents, ms), None
+
+    num_scan = schedule.timesteps.shape[0]
+    assert 1 <= gate <= num_scan, (gate, num_scan)
+    steps = jnp.arange(num_scan, dtype=jnp.int32)
+    (latents, _), _ = jax.lax.scan(
+        body2, (carry.latents, carry.ms),
+        (steps[gate:], schedule.timesteps[gate:]))
+    return latents
+
+
 def _denoise_scan(
     unet_params: Any,
     cfg: PipelineConfig,
@@ -249,16 +484,6 @@ def _denoise_scan(
     """
     emit = progress or metrics
     b = latents.shape[0]
-    state = (init_store_state(layout, b, dtype=jnp.float32)
-             if (controller is not None and controller.needs_store) else ())
-
-    use_plms = scheduler_kind == "plms"
-    use_dpm = scheduler_kind == "dpm"
-    # Multistep-solver state carried through the scan — and, when gated,
-    # across the phase boundary (PLMS ring buffer or DPM x0 history; None
-    # for single-step DDIM).
-    ms_state = sched_mod.init_multistep_state(scheduler_kind, latents.shape,
-                                              latents.dtype)
     num_scan = schedule.timesteps.shape[0]
     if gate is None:
         gate = num_scan
@@ -268,104 +493,45 @@ def _denoise_scan(
         raise ValueError("phase-gated sampling cannot run under per-step "
                          "null-text uncond embeddings (validated upstream)")
 
-    def ms_step(ms, eps, t, latents):
-        if use_plms:
-            return sched_mod.plms_step(schedule, ms, eps, t, latents)
-        if use_dpm:
-            return sched_mod.dpm_step(schedule, ms, eps, t, latents)
-        return ms, sched_mod.ddim_step(schedule, eps, t, latents)
-
-    def body(carry, scan_in, capture: bool):
-        if capture:
-            latents, state, ms, cache, resid = carry
-        else:
-            latents, state, ms = carry
-        step, t = scan_in
-        progress_mod.emit_step(emit, step, phase="phase1", report=progress)
-        ctx = context
-        if uncond_per_step is not None:
-            # Null-text: substitute this step's optimized uncond embedding.
-            # Cast to the sampling dtype — the artifact stores f32 (the
-            # optimizer's dtype), and a f32 leak here would silently promote
-            # the whole CFG context (and the U-Net matmuls) on the bf16 path.
-            u = jax.lax.dynamic_index_in_dim(uncond_per_step, step, 0, keepdims=False)
-            ctx = jnp.concatenate([jnp.broadcast_to(u.astype(context.dtype),
-                                                    context[:b].shape),
-                                   context[b:]], axis=0)
-        latent_in = jnp.concatenate([latents] * 2, axis=0)
-        if capture:
-            eps, state, cache = apply_unet(
-                unet_params, cfg.unet, latent_in, t, ctx,
-                layout=layout, controller=controller, state=state, step=step,
-                sp=sp, attn_cache=cache, cache_mode="store")
-        else:
-            eps, state = apply_unet(
-                unet_params, cfg.unet, latent_in, t, ctx,
-                layout=layout, controller=controller, state=state, step=step,
-                sp=sp)
-        eps_uncond, eps_text = eps[:b], eps[b:]
-        if capture:
-            resid = eps_text - eps_uncond
-            eps = eps_uncond + guidance_scale * resid
-        else:
-            eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
-        # v-prediction models (SD-2.1 768-v): convert to ε once per step.
-        # Linear in the model output, so combining CFG first is equivalent.
-        eps = sched_mod.to_epsilon(schedule, eps, t, latents)
-        ms, latents = ms_step(ms, eps, t, latents)
-        latents = apply_step_callback(controller, layout, state, latents, step)
-        if capture:
-            return (latents, state, ms, cache, resid), None
-        return (latents, state, ms), None
-
-    steps = jnp.arange(num_scan, dtype=jnp.int32)
     if not gated:
         # Feature off: the exact pre-existing program (no cache buffers, no
         # residual carry) — gate=S is bitwise-identical by construction.
+        state = (init_store_state(layout, b, dtype=jnp.float32)
+                 if (controller is not None and controller.needs_store)
+                 else ())
+        # Multistep-solver state carried through the scan (PLMS ring buffer
+        # or DPM x0 history; None for single-step DDIM). The gated path
+        # initializes its own inside ``_phase1_scan`` and hands the SAME
+        # carry across the phase boundary.
+        ms_state = sched_mod.init_multistep_state(
+            scheduler_kind, latents.shape, latents.dtype)
+        body = _make_phase1_body(unet_params, cfg, layout, schedule,
+                                 scheduler_kind, context, b, controller,
+                                 guidance_scale, uncond_per_step, emit,
+                                 progress, sp, capture=False)
+        steps = jnp.arange(num_scan, dtype=jnp.int32)
         (latents, state, _), _ = jax.lax.scan(
-            partial(body, capture=False), (latents, state, ms_state),
+            body, (latents, state, ms_state),
             (steps, schedule.timesteps))
         return latents, state
 
-    # Phase 1: CFG + hooks + capture. Latent math is identical to the ungated
-    # body (the capture only adds carry writes), so phase-1 latents match the
-    # baseline bitwise.
-    cache = init_attn_cache(layout, b, dtype=latents.dtype)
-    resid = jnp.zeros_like(latents)
-    (latents, state, ms_state, cache, resid), _ = jax.lax.scan(
-        partial(body, capture=True),
-        (latents, state, ms_state, cache, resid),
-        (steps[:gate], schedule.timesteps[:gate]))
-
+    # Gated: the same two phase programs the serve layer's disaggregated
+    # pools compile separately (``_phase1_scan`` / ``_phase2_scan``),
+    # composed here into one monolithic program — op-for-op the split
+    # execution, which is what makes a pooled hand-off bitwise-equal to a
+    # single-program gated run.
+    carry = _phase1_scan(unet_params, cfg, layout, schedule, scheduler_kind,
+                         context, latents, controller, guidance_scale,
+                         gate=gate, progress=progress, metrics=metrics,
+                         sp=sp)
     # Slice the conditional context half once, outside the phase-2 body: a
     # slice inside the scan would pull the full [uncond; cond] tensor into
     # the body as a constant — the uncond half must not even be an input.
-    context_cond = context[b:]
-
-    def body2(carry, scan_in):
-        latents, ms = carry
-        step, t = scan_in
-        progress_mod.emit_step(emit, step, phase="phase2", report=progress)
-        eps_text, _ = apply_unet(
-            unet_params, cfg.unet, latents, t, context_cond,
-            layout=layout, controller=None, state=(), step=step, sp=sp,
-            attn_cache=cache, cache_mode="use")
-        # SD-Acc-style fixed extrapolation: CFG's uncond branch is gone;
-        # ε = ε_text + (g−1)·(ε_text − ε_uncond)|_gate reuses the captured
-        # last-phase-1 residual as the guidance direction.
-        eps = eps_text + (guidance_scale - 1.0) * resid
-        eps = sched_mod.to_epsilon(schedule, eps, t, latents)
-        ms, latents = ms_step(ms, eps, t, latents)
-        # Latent-space controller effects (LocalBlend compositing /
-        # SpatialReplace injection) continue against the frozen phase-1
-        # store; attention hooks are structurally gone.
-        latents = apply_step_callback(controller, layout, state, latents, step)
-        return (latents, ms), None
-
-    (latents, _), _ = jax.lax.scan(
-        body2, (latents, ms_state),
-        (steps[gate:], schedule.timesteps[gate:]))
-    return latents, state
+    latents = _phase2_scan(unet_params, cfg, layout, schedule,
+                           scheduler_kind, context[b:], carry, controller,
+                           guidance_scale, gate=gate, progress=progress,
+                           metrics=metrics, sp=sp)
+    return latents, carry.state
 
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",

@@ -22,7 +22,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..controllers.base import AttnLayout, Controller
-from ..engine.sampler import (_denoise_scan, resolve_gate, stage_host,
+from ..engine.sampler import (PhaseCarry, _denoise_scan, _phase1_scan,
+                              _phase2_scan, resolve_gate, stage_host,
                               warn_gate_truncation)
 from ..models import vae as vae_mod
 from ..models.config import PipelineConfig
@@ -61,6 +62,25 @@ def _sweep_jit(
         return vae_mod.to_uint8(image), lat
 
     return jax.vmap(one_group)(context, latents, controllers, uncond_per_step)
+
+
+def _stage_sharded(x, gspec: NamedSharding):
+    """Put a host-replicated value onto the mesh under ``gspec``.
+
+    Single-process: plain ``jax.device_put``. Multi-process: modern jax's
+    ``device_put`` of an unsharded value onto a multihost sharding runs a
+    cross-host ``assert_equal`` collective (``broadcast_one_to_all``) that
+    the CPU gloo backend cannot execute ("Multiprocess computations aren't
+    implemented on the CPU backend" — the test_multihost_2proc drift).
+    Every process already holds the identical full value (seeded
+    identically by construction), so each just donates its own addressable
+    shards via ``make_array_from_callback`` — no collective at all, and
+    bitwise the same global array."""
+    if jax.process_count() <= 1:
+        return jax.device_put(x, gspec)
+    x_np = np.asarray(x)
+    return jax.make_array_from_callback(x_np.shape, gspec,
+                                        lambda idx: x_np[idx])
 
 
 def sweep(
@@ -144,13 +164,13 @@ def sweep(
 
     if mesh is not None:
         gspec = NamedSharding(mesh, P("dp"))
-        context = jax.device_put(context, gspec)
-        latents = jax.device_put(latents, gspec)
+        context = _stage_sharded(context, gspec)
+        latents = _stage_sharded(latents, gspec)
         if controllers is not None:
             controllers = jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, gspec), controllers)
+                lambda x: _stage_sharded(x, gspec), controllers)
         if uncond_per_step is not None:
-            uncond_per_step = jax.device_put(uncond_per_step, gspec)
+            uncond_per_step = _stage_sharded(uncond_per_step, gspec)
 
     if progress:
         from ..utils import progress as progress_mod
@@ -166,6 +186,156 @@ def sweep(
                           schedule, scheduler, context, latents, controllers,
                           gs, uncond_per_step, progress=progress,
                           gate=gate_step, metrics=metrics)
+
+
+@partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
+                                   "progress", "gate", "metrics"),
+         donate_argnums=())
+def _sweep_phase1_jit(
+    unet_params: Any,
+    cfg: PipelineConfig,
+    layout: AttnLayout,
+    schedule: sched_mod.DiffusionSchedule,
+    scheduler_kind: str,
+    context: jax.Array,        # (G, 2B, L, D) per-group [uncond; cond]
+    latents: jax.Array,        # (G, B, h, w, c)
+    controllers: Optional[Controller],   # leaves with leading G axis (or None)
+    guidance_scale: jax.Array,
+    progress: bool = False,
+    gate: int = 1,
+    metrics: bool = False,
+) -> PhaseCarry:
+    """The serve layer's phase-1 POOL program: steps ``[0, gate)`` of G
+    groups under full CFG + controller hooks, returning the per-group
+    :class:`~p2p_tpu.engine.sampler.PhaseCarry` (leaves carry a leading G
+    axis) instead of images — no VAE decode, the trajectory continues in a
+    separately scheduled phase-2 program."""
+    def one_group(ctx, lat, ctrl):
+        return _phase1_scan(unet_params, cfg, layout, schedule,
+                            scheduler_kind, ctx, lat, ctrl, guidance_scale,
+                            gate=gate, progress=progress, metrics=metrics)
+
+    return jax.vmap(one_group)(context, latents, controllers)
+
+
+@partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
+                                   "progress", "gate", "metrics"),
+         donate_argnums=())
+def _sweep_phase2_jit(
+    unet_params: Any,
+    vae_params: Any,
+    cfg: PipelineConfig,
+    layout: AttnLayout,
+    schedule: sched_mod.DiffusionSchedule,
+    scheduler_kind: str,
+    context_cond: jax.Array,   # (G, B, L, D) — cond half only, no uncond
+    carry: PhaseCarry,         # leaves with leading G axis
+    controllers: Optional[Controller],   # phase-2 slice, G-leading (or None)
+    guidance_scale: jax.Array,
+    progress: bool = False,
+    gate: int = 1,
+    metrics: bool = False,
+):
+    """The serve layer's phase-2 POOL program: steps ``[gate, S)`` of G
+    hand-off carries — single-branch U-Net off the AttnCache, fixed-
+    extrapolation guidance, then the VAE decode. The G lanes may come from
+    *different* requests (different phase-1 batches): everything request-
+    specific rides the carry and the cond context. Returns
+    ``(images (G,B,H,W,3) uint8, final latents)``."""
+    def one_group(ctx_c, car, ctrl):
+        lat = _phase2_scan(unet_params, cfg, layout, schedule,
+                           scheduler_kind, ctx_c, car, ctrl, guidance_scale,
+                           gate=gate, progress=progress, metrics=metrics)
+        image = vae_mod.decode(vae_params, cfg.vae, lat.astype(jnp.float32))
+        return vae_mod.to_uint8(image), lat
+
+    return jax.vmap(one_group)(context_cond, carry, controllers)
+
+
+def _phase_args(pipe, num_steps: int, scheduler: str, gate,
+                guidance_scale, layout, controllers):
+    """Shared wrapper plumbing for the two pool entry points: schedule,
+    resolved+validated gate (a pool program needs both phases non-empty),
+    staged guidance, layout."""
+    cfg = pipe.config
+    if layout is None:
+        from ..models.config import unet_layout
+        layout = unet_layout(cfg.unet)
+    schedule = sched_mod.schedule_from_config(num_steps, cfg.scheduler,
+                                              kind=scheduler)
+    num_scan = schedule.timesteps.shape[0]
+    gate_step = resolve_gate(gate, num_scan, controllers)
+    if not 1 <= gate_step < num_scan:
+        raise ValueError(
+            f"a phase pool program needs a real gate: resolved gate step "
+            f"{gate_step} of {num_scan} leaves a phase empty — ungated "
+            "requests take the single-pool sweep() path")
+    gs = (guidance_scale if isinstance(guidance_scale, jax.Array)
+          else stage_host(np.float32(guidance_scale)))
+    return cfg, layout, schedule, gate_step, gs
+
+
+def sweep_phase1(
+    pipe,
+    context: jax.Array,
+    latents: jax.Array,
+    controllers: Optional[Controller],
+    *,
+    num_steps: int = 50,
+    guidance_scale: float = 7.5,
+    scheduler: str = "ddim",
+    layout: Optional[AttnLayout] = None,
+    gate=None,
+    progress: bool = False,
+    metrics: bool = False,
+) -> PhaseCarry:
+    """Run phase 1 of G groups (same shapes/semantics as :func:`sweep`) and
+    return the hand-off carry instead of images. ``gate`` must resolve
+    strictly inside ``(0, S)``."""
+    cfg, layout, schedule, gate_step, gs = _phase_args(
+        pipe, num_steps, scheduler, gate, guidance_scale, layout,
+        controllers)
+    warn_gate_truncation(gate_step, schedule.timesteps.shape[0], controllers)
+    from ..obs.spans import span
+
+    with span("sampler.sweep_phase1", groups=int(context.shape[0]),
+              steps=int(schedule.timesteps.shape[0]), gate=int(gate_step)):
+        return _sweep_phase1_jit(pipe.unet_params, cfg, layout, schedule,
+                                 scheduler, context, latents, controllers,
+                                 gs, progress=progress, gate=gate_step,
+                                 metrics=metrics)
+
+
+def sweep_phase2(
+    pipe,
+    context_cond: jax.Array,
+    carry: PhaseCarry,
+    controllers: Optional[Controller],
+    *,
+    num_steps: int = 50,
+    guidance_scale: float = 7.5,
+    scheduler: str = "ddim",
+    layout: Optional[AttnLayout] = None,
+    gate=None,
+    progress: bool = False,
+    metrics: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Finish G hand-off carries: steps ``[gate, S)`` + VAE decode.
+    ``controllers`` must already be the phase-2 slice
+    (``engine.sampler.phase2_controller``, stacked over G — or None);
+    passing a full edit controller here would silently split pools that
+    could share one program. Returns ``(images, final latents)``."""
+    cfg, layout, schedule, gate_step, gs = _phase_args(
+        pipe, num_steps, scheduler, gate, guidance_scale, layout,
+        controllers)
+    from ..obs.spans import span
+
+    with span("sampler.sweep_phase2", groups=int(context_cond.shape[0]),
+              steps=int(schedule.timesteps.shape[0]), gate=int(gate_step)):
+        return _sweep_phase2_jit(pipe.unet_params, pipe.vae_params, cfg,
+                                 layout, schedule, scheduler, context_cond,
+                                 carry, controllers, gs, progress=progress,
+                                 gate=gate_step, metrics=metrics)
 
 
 def artifact_replay_inputs(pipe, x_t, uncond_embeddings, source: str,

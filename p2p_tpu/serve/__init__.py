@@ -17,6 +17,7 @@ from .batcher import BUCKET_SIZES, DynamicBatcher, bucket_for
 from .chaos import FaultPlan
 from .engine_loop import DegradeConfig, serve_forever
 from .faults import InjectedFault, RetryPolicy, WatchdogTimeout, classify
+from .handoff import HandoffEntry
 from .journal import Journal, ReplayState, replay
 from .programs import ProgramCache
 from .queue import AdmissionQueue, Rejected
@@ -29,6 +30,7 @@ __all__ = [
     "DegradeConfig",
     "DynamicBatcher",
     "FaultPlan",
+    "HandoffEntry",
     "InjectedFault",
     "Journal",
     "ProgramCache",

@@ -18,7 +18,7 @@ a few wasted lanes for keeping compiles off the request path entirely.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..obs import metrics as obs_metrics
 from .queue import Entry
@@ -58,15 +58,30 @@ class Batch:
         return self.entries[0].prepared.compile_key
 
 
-class DynamicBatcher:
-    """Groups entries by ``batch_key``; flushes on max-batch or max-wait."""
+def _default_key(entry: Entry) -> Tuple:
+    return entry.prepared.batch_key
 
-    def __init__(self, max_batch: int = 8, max_wait_ms: float = 50.0):
+
+class DynamicBatcher:
+    """Groups entries by ``key_fn`` (default: the monolithic ``batch_key``);
+    flushes on max-batch or max-wait.
+
+    The phase-disaggregated engine runs TWO of these: the admission-side
+    pool (mono + phase-1 batches, default key) and the hand-off-side
+    phase-2 pool (``key_fn`` selecting ``prepared.phase2_batch_key``,
+    entries are ``handoff.HandoffEntry``). ``pool`` labels the shared
+    metric families so the two pools' timelines stay distinguishable."""
+
+    def __init__(self, max_batch: int = 8, max_wait_ms: float = 50.0,
+                 key_fn: Optional[Callable[[Entry], Tuple]] = None,
+                 pool: str = "main"):
         if max_batch not in BUCKET_SIZES:
             raise ValueError(
                 f"max_batch must be one of {BUCKET_SIZES}, got {max_batch}")
         self.max_batch = max_batch
         self.max_wait_ms = float(max_wait_ms)
+        self.key_fn = key_fn or _default_key
+        self.pool = pool
         self._waiting: Dict[Tuple, List[Entry]] = {}
         self._oldest_ms: Dict[Tuple, float] = {}
         reg = obs_metrics.registry()
@@ -75,20 +90,21 @@ class DynamicBatcher:
         # is the binding constraint (docs/OBSERVABILITY.md).
         self._m_flush = reg.counter(
             "serve_batch_flushes_total", "batcher flushes by cause",
-            labels=("cause",))
+            labels=("cause", "pool"))
         self._m_waiting = reg.gauge(
-            "serve_batcher_waiting", "entries held in batcher buckets")
+            "serve_batcher_waiting", "entries held in batcher buckets",
+            labels=("pool",))
 
     def __len__(self) -> int:
         return sum(len(v) for v in self._waiting.values())
 
     def add(self, entry: Entry, now_ms: float) -> None:
-        key = entry.prepared.batch_key
+        key = self.key_fn(entry)
         group = self._waiting.setdefault(key, [])
         if not group:
             self._oldest_ms[key] = now_ms
         group.append(entry)
-        self._m_waiting.set(len(self))
+        self._m_waiting.labels(pool=self.pool).set(len(self))
 
     def next_flush_ms(self) -> Optional[float]:
         """Earliest future time a waiting bucket ages out (None when empty).
@@ -106,7 +122,7 @@ class DynamicBatcher:
         else:
             del self._waiting[key]
             del self._oldest_ms[key]
-        self._m_waiting.set(len(self))
+        self._m_waiting.labels(pool=self.pool).set(len(self))
         return Batch(batch_key=key, entries=taken, created_ms=now_ms)
 
     def ready(self, now_ms: float) -> List[Batch]:
@@ -116,11 +132,11 @@ class DynamicBatcher:
             while key in self._waiting and \
                     len(self._waiting[key]) >= self.max_batch:
                 out.append(self._pop(key, self.max_batch, now_ms))
-                self._m_flush.labels(cause="full").inc()
+                self._m_flush.labels(cause="full", pool=self.pool).inc()
             if key in self._waiting and \
                     now_ms - self._oldest_ms[key] >= self.max_wait_ms:
                 out.append(self._pop(key, self.max_batch, now_ms))
-                self._m_flush.labels(cause="age").inc()
+                self._m_flush.labels(cause="age", pool=self.pool).inc()
         out.sort(key=lambda b: min(e.seq for e in b.entries))
         return out
 
@@ -130,6 +146,6 @@ class DynamicBatcher:
         for key in list(self._waiting):
             while key in self._waiting:
                 out.append(self._pop(key, self.max_batch, now_ms))
-                self._m_flush.labels(cause="drain").inc()
+                self._m_flush.labels(cause="drain", pool=self.pool).inc()
         out.sort(key=lambda b: min(e.seq for e in b.entries))
         return out

@@ -9,13 +9,43 @@ given trace and runner, while latency numbers stay real measurements. A
 JSONL file replay, the bench ``serve`` rehearsal, the chaos drill and the
 tests all ride the same loop.
 
+**Phase-disaggregated continuous batching** (``phase_pools``, on by
+default): PR 1 made denoising steps heterogeneous — a phase-1 step (full
+CFG + controller hooks) costs ~2× a phase-2 step (single-branch U-Net off
+the ``AttnCache``) — so a *gated* request no longer holds one lane for its
+whole trajectory. It runs as two separately scheduled program pools with
+an explicit hand-off (``serve.handoff``, the vLLM continuous-batching idea
+mapped onto diffusion's phase structure):
+
+- the **phase-1 pool** batches by the monolithic batch key and runs steps
+  ``[0, gate)`` through a ``("phase1", ...)``-keyed program that returns
+  the per-lane :class:`~p2p_tpu.engine.sampler.PhaseCarry`;
+- each carry enters the **phase-2 batcher**, keyed by the *reduced*
+  ``phase2_batch_key`` (attention-edit structure is gone past the gate),
+  where lanes from different requests — different arrival times, different
+  phase-1 batches, even different edit modes — pack into wide cheap
+  batches at the same {1,2,4,8} buckets (default cap: one bucket above
+  ``max_batch`` — a phase-2 lane carries no uncond half, so 2× the lanes
+  fit the same peak footprint);
+- phase-1 lanes vacate at the gate, so new admissions fill them while
+  earlier requests are still denoising in phase 2.
+
+Phase-2 flushes dispatch *before* new phase-1 work each cycle (finish
+nearly-done requests first: frees outstanding slots, bounds p95). Ungated
+traffic (``gate`` absent / ``off``) never touches any of this: it takes
+the single-pool monolithic path bitwise-unchanged, control flow included.
+
 Every submitted request resolves to exactly ONE structured record:
 
 - ``ok`` — served; carries ``images`` (B, H, W, 3) uint8 plus the latency
   split: ``queue_wait_ms`` (arrival → dispatch), ``compile_ms`` (its
   batch's program build/warm cost, 0 on a program-cache hit), ``run_ms``
   (batch execution), ``total_ms``; plus ``batch_lanes`` (padded bucket),
-  ``batch_occupancy`` (real lanes), ``cache_hit``.
+  ``batch_occupancy`` (real lanes), ``cache_hit``. Gated requests served
+  through the disaggregated pools additionally carry a ``phases`` detail
+  (phase-1 batch facts, ``handoff_wait_ms``, phase-2 batch facts);
+  ``compile_ms``/``run_ms`` are then the summed per-phase components and
+  the batch fields describe the completing (phase-2) batch.
 - ``rejected`` — failed validation or backpressure; ``reason`` says why.
 - ``expired`` — deadline passed before dispatch (never runs).
 - ``cancelled`` — a ``{"cancel": id}`` record landed before dispatch.
@@ -76,9 +106,11 @@ from typing import Callable, Iterable, Iterator, List, Optional
 from ..obs import metrics as obs_metrics
 from ..obs.spans import span
 from . import faults as faults_mod
+from . import handoff as handoff_mod
 from . import queue as queue_mod
 from .batcher import BUCKET_SIZES, Batch, DynamicBatcher, bucket_for
 from .faults import RetryPolicy
+from .handoff import HandoffEntry
 from .programs import ProgramCache, default_runner_factory
 from .queue import AdmissionQueue, Rejected
 from .request import Cancel, Request, prepare
@@ -193,6 +225,15 @@ def _shrunken_bucket(max_batch: int, floor: int) -> int:
     return min(max_batch, max(floor, BUCKET_SIZES[max(0, idx - 1)]))
 
 
+def _wider_bucket(max_batch: int) -> int:
+    """One fixed bucket above ``max_batch`` (capped at the largest) — the
+    phase-2 pool's default cap: a phase-2 lane carries no CFG uncond half,
+    so a bucket of 2N phase-2 lanes peaks at the same U-Net batch as N
+    phase-1 lanes."""
+    idx = BUCKET_SIZES.index(max_batch)
+    return BUCKET_SIZES[min(idx + 1, len(BUCKET_SIZES) - 1)]
+
+
 def serve_forever(
     pipe,
     requests: Iterable,
@@ -211,6 +252,8 @@ def serve_forever(
     watchdog_ms: Optional[float] = None,
     validate_outputs: bool = False,
     degrade: Optional[DegradeConfig] = None,
+    phase_pools: bool = True,
+    phase2_max_batch: Optional[int] = None,
 ) -> Iterator[dict]:
     """Drain ``requests`` (Request/Cancel objects or JSONL-shaped dicts,
     sorted by ``arrival_ms``) through the queue → batcher → program-cache →
@@ -231,6 +274,15 @@ def serve_forever(
     deadline past dispatch; ``validate_outputs`` runs the post-run finite
     check per lane; ``degrade`` enables graceful degradation under
     sustained queue pressure.
+
+    ``phase_pools`` enables phase-disaggregated continuous batching for
+    *gated* requests (see the module docstring); ``phase_pools=False`` is
+    the single-pool baseline (every request runs its monolithic program —
+    the pre-disaggregation engine, kept for A/B benching). Ungated traffic
+    is single-pool either way, bitwise-unchanged. ``phase2_max_batch``
+    caps the phase-2 pool's lane bucket (default: one fixed bucket above
+    ``max_batch`` — same peak U-Net footprint, since phase-2 lanes carry
+    no CFG uncond half).
     """
     from ..engine.sampler import lane_select
     from ..utils import progress as progress_mod
@@ -241,6 +293,14 @@ def serve_forever(
     policy = retry_policy or RetryPolicy()
     queue = AdmissionQueue(queue_cap)
     batcher = DynamicBatcher(max_batch=max_batch, max_wait_ms=max_wait_ms)
+    if phase2_max_batch is None:
+        phase2_max_batch = _wider_bucket(max_batch)
+    elif phase2_max_batch not in BUCKET_SIZES:
+        raise ValueError(f"phase2_max_batch must be one of {BUCKET_SIZES}, "
+                         f"got {phase2_max_batch}")
+    batcher2 = DynamicBatcher(
+        max_batch=phase2_max_batch, max_wait_ms=max_wait_ms,
+        key_fn=lambda e: e.prepared.phase2_batch_key, pool="phase2")
     # The cache shares the loop's retry policy: transient *build* failures
     # (prewarm and in-band misses) back off on the wall clock inside the
     # cache; execution faults stay classified at dispatch and back off on
@@ -262,6 +322,13 @@ def serve_forever(
     latencies: List[float] = []
     occupancies: List[int] = []
     batch_hits: List[bool] = []
+    # Per-pool dispatch accounting (phase-disaggregated batching): the
+    # flat lists above stay the whole-loop aggregate (every successful
+    # dispatch, any pool), these split it per phase for the summary's
+    # ``phases`` block and the ≥1.3× bench comparison.
+    occ_by_phase = {"phase1": [], "phase2": []}
+    handoffs_total = 0
+    resumed_handoffs = 0
     prewarm_ms = 0.0
     vnow = 0.0
     batch_index = 0
@@ -280,22 +347,37 @@ def serve_forever(
                              labels=("status",))
     m_rejects = reg.counter("serve_admission_rejects_total",
                             "admission rejections by kind", labels=("kind",))
+    # Stage histograms carry a ``phase`` label (phase-disaggregated
+    # accounting): ``mono`` for single-pool requests; gated requests
+    # observe their phase-1 and phase-2 components separately (and their
+    # whole-request total under ``gated``) so the two pools' latency
+    # stories never blur into one distribution.
     m_stage = {
         "queue_wait_ms": reg.histogram(
-            "serve_queue_wait_ms", "arrival -> dispatch wait per request"),
+            "serve_queue_wait_ms",
+            "arrival -> dispatch wait per request (phase2: hand-off -> "
+            "phase-2 dispatch)", labels=("phase",)),
         "compile_ms": reg.histogram(
             "serve_compile_ms",
             "in-band build time of the request's batch (0 on cache hit; "
             "observed once per ok lane, like the record field — sum over "
-            "a batch overcounts by its occupancy)"),
+            "a batch overcounts by its occupancy)", labels=("phase",)),
         "run_ms": reg.histogram(
-            "serve_run_ms", "batch execution wall time per request"),
+            "serve_run_ms", "batch execution wall time per request",
+            labels=("phase",)),
         "total_ms": reg.histogram(
-            "serve_request_total_ms", "arrival -> images latency"),
+            "serve_request_total_ms", "arrival -> images latency",
+            labels=("phase",)),
     }
     m_occupancy = reg.histogram(
         "serve_batch_occupancy", "real lanes per dispatched batch",
-        buckets=tuple(float(b) for b in BUCKET_SIZES))
+        buckets=tuple(float(b) for b in BUCKET_SIZES), labels=("phase",))
+    m_handoffs = reg.counter(
+        "serve_handoffs_total",
+        "gated requests handed off from the phase-1 to the phase-2 pool")
+    m_resumed = reg.counter(
+        "serve_handoff_resumed_total",
+        "crash-replayed requests resumed in phase 2 off a journaled carry")
     m_upsized = reg.counter(
         "serve_bucket_upsized_total",
         "batches padded up to a larger warm bucket (warm-preference)")
@@ -331,25 +413,31 @@ def serve_forever(
         labels=("kind",))
 
     def record(status: str, request_id: str, *, release: bool = True,
-               journal_write: bool = True, **fields) -> dict:
+               journal_write: bool = True, stage_phase: Optional[str] = "mono",
+               **fields) -> dict:
         # release=False for admission rejections: a rejected submission was
         # never admitted, and its id may belong to a still-live earlier
         # request (duplicate-id rejection) whose capacity slot and cancel
         # marker must survive. journal_write=False for the same duplicate
         # case — a terminal WAL line for the duplicate's id would make a
-        # crash-replay drop the still-live original.
+        # crash-replay drop the still-live original. stage_phase labels the
+        # auto-observed stage histograms of an ok record ("mono" for the
+        # single-pool path); gated oks pass None and observe their per-phase
+        # split at the phase-2 dispatch site instead.
         counts[status] += 1
         m_requests.labels(status=status).inc()
-        if status == "ok":
+        if status == "ok" and stage_phase is not None:
             for key, hist in m_stage.items():
                 if key in fields:
-                    hist.observe(float(fields[key]))
+                    hist.labels(phase=stage_phase).observe(
+                        float(fields[key]))
         if request_id in replayed_ids:
             fields.setdefault("replayed", True)
         if request_id in forced_gate_ids:
             fields.setdefault("degraded_gate", True)
         if journal is not None and journal_write:
             journal.terminal(request_id, status, vnow)
+            journal.discard_carry(request_id)
         if release:
             queue.release(request_id)
         return {"request_id": request_id, "status": status, **fields}
@@ -391,8 +479,34 @@ def serve_forever(
                         req = Request.from_dict(d)
                         req = dataclasses.replace(req, arrival_ms=0.0)
                         prep = prepare(req, pipe)
+                        rid = req.request_id
+                        ho = rs.handoffs.get(rid)
+                        if (ho is not None and prep.gated and phase_pools):
+                            # The WAL says phase 1 already ran: resume in
+                            # phase 2 off the spilled carry — exactly-once
+                            # state, and not even phase-1 compute is
+                            # repeated. A lost/corrupt spill falls back to
+                            # a full re-run (at-least-once compute, the
+                            # journal's existing contract).
+                            try:
+                                carry = handoff_mod.load_carry(
+                                    ho["carry_path"],
+                                    handoff_mod.carry_template(pipe, prep))
+                            except ValueError:
+                                carry = None
+                                m_replay.labels(kind="handoff_lost").inc()
+                            if carry is not None:
+                                entry = queue.admit_inflight(prep, 0.0)
+                                batcher2.add(HandoffEntry(
+                                    entry=entry, carry=carry,
+                                    handoff_ms=0.0, resumed=True), 0.0)
+                                resumed_handoffs += 1
+                                m_resumed.inc()
+                                replayed_ids.add(rid)
+                                m_replay.labels(kind="handoff_resumed").inc()
+                                continue
                         queue.submit(prep, 0.0)
-                        replayed_ids.add(req.request_id)
+                        replayed_ids.add(rid)
                         m_replay.labels(kind="pending").inc()
                     except (Rejected, ValueError) as e:
                         rid = d.get("request_id", "?")
@@ -415,11 +529,22 @@ def serve_forever(
                     # proper 'rejected' record if/when it arrives in the
                     # trace.
                     continue
-                bucket = bucket_for(max_batch, max_batch)
                 entry = queue_mod.Entry(prepared=prep, arrival_ms=0.0)
-                cache.get((prep.compile_key, bucket),
-                          lambda p=prep, b=bucket, e=entry: _build(
-                              make_runner, p.compile_key, b, [e]))
+                if prep.gated and phase_pools:
+                    # A gated request compiles into TWO pool programs;
+                    # warm both at their pools' max buckets so neither
+                    # phase pays a compile in-band.
+                    keys = ((prep.phase1_key, bucket_for(max_batch,
+                                                         max_batch)),
+                            (prep.phase2_key, bucket_for(phase2_max_batch,
+                                                         phase2_max_batch)))
+                else:
+                    keys = ((prep.compile_key, bucket_for(max_batch,
+                                                          max_batch)),)
+                for key, bucket in keys:
+                    cache.get((key, bucket),
+                              lambda k=key, b=bucket, e=entry: _build(
+                                  make_runner, k, b, [e]))
         prewarm_ms = (timer() - t0) * 1000.0
 
     def run_entries(entries, compile_key, guidance, bucket, fault=None):
@@ -528,6 +653,12 @@ def serve_forever(
         return recs, still
 
     def dispatch(batch: Batch) -> Iterator[dict]:
+        if phase_pools and batch.entries[0].prepared.gated:
+            # Gated requests ride the disaggregated pools; everything else
+            # falls through to the monolithic path below, which is the
+            # pre-disaggregation engine bitwise-unchanged.
+            yield from dispatch_phase1(batch)
+            return
         nonlocal vnow, batch_index, retries_total
         live = []
         for e in batch.entries:
@@ -626,7 +757,7 @@ def serve_forever(
         # histogram and mean_batch_occupancy reconcile exactly (a poisoned
         # batch contributes to neither — its lanes re-dispatch via
         # isolate()).
-        m_occupancy.observe(float(len(live)))
+        m_occupancy.labels(phase="mono").observe(float(len(live)))
         batch_hits.append(hit)
         bad = set()
         if finite is not None:
@@ -713,7 +844,8 @@ def serve_forever(
                 continue
             vnow += compile_ms + run_ms
             occupancies.append(1)
-            m_occupancy.observe(1.0)  # success-only, mirroring dispatch()
+            # success-only, mirroring dispatch()
+            m_occupancy.labels(phase="mono").observe(1.0)
             batch_hits.append(hit)
             if ((finite is not None and not bool(finite[0])) or
                     (fault is not None and fault.kind == "nan"
@@ -738,6 +870,424 @@ def serve_forever(
                 cache_hit=hit, isolated_retry=True,
                 gate_step=e.prepared.gate_step,
                 **({"steps_done": steps_done} if steps_done else {}))
+
+    # ------------------------------------------------------------------
+    # Phase-disaggregated pools: phase-1 dispatch → hand-off → phase-2
+    # dispatch. Fault semantics (classify / retry / isolate / quarantine /
+    # drain) apply per pool, mirroring the monolithic paths above.
+    # ------------------------------------------------------------------
+
+    def do_handoff(entries, carry_g, batch_id, lanes, occupancy,
+                   dispatch_ms, compile_ms, run_ms, hit,
+                   isolated: bool = False, fault=None) -> None:
+        """Phase-1 success: split the pool carry per lane and queue each
+        request (with its carry and phase-1 latency facts) into the
+        phase-2 batcher. No record is emitted — the request is still
+        live, mid-trajectory. A chaos 'nan' fault taken at this dispatch
+        marks its victim lanes so the completion-time finite verdict
+        converts them (the monolithic path's semantics)."""
+        nonlocal handoffs_total
+        nan_rids = (set(fault.rids)
+                    if (fault is not None and fault.kind == "nan"
+                        and validate_outputs) else set())
+        carries = handoff_mod.lane_carries(carry_g, len(entries))
+        for e, c in zip(entries, carries):
+            p1 = {"batch_id": batch_id, "lanes": lanes,
+                  "occupancy": occupancy,
+                  "queue_wait_ms": dispatch_ms - e.arrival_ms,
+                  "compile_ms": compile_ms, "run_ms": run_ms,
+                  "cache_hit": hit}
+            if isolated:
+                p1["isolated_retry"] = True
+            if journal is not None:
+                path = journal.carry_path(e.request_id)
+                spec = handoff_mod.spill_carry(c, path)
+                journal.handoff(e.request_id, vnow, path, spec)
+            handoffs_total += 1
+            m_handoffs.inc()
+            batcher2.add(HandoffEntry(entry=e, carry=c, handoff_ms=vnow,
+                                      phase1=p1,
+                                      nan_injected=e.request_id in nan_rids),
+                         vnow)
+
+    def dispatch_phase1(batch: Batch) -> Iterator[dict]:
+        nonlocal vnow, batch_index, retries_total
+        live = []
+        for e in batch.entries:
+            if queue.is_cancelled(e.request_id):
+                yield record("cancelled", e.request_id,
+                             arrival_ms=e.arrival_ms,
+                             queue_wait_ms=vnow - e.arrival_ms)
+            elif queue_mod.expired(e, vnow):
+                yield record(
+                    "expired", e.request_id, arrival_ms=e.arrival_ms,
+                    reason=(f"deadline {e.request.deadline_ms}ms passed "
+                            f"before dispatch (waited "
+                            f"{vnow - e.arrival_ms:.1f}ms)"))
+            else:
+                live.append(e)
+        if not live:
+            return
+        batch_index += 1
+        this_batch = batch_index
+        guidance = live[0].request.guidance
+        compile_key = live[0].prepared.phase1_key
+        bucket = _pick_bucket(len(live), compile_key, batcher.max_batch,
+                              cache)
+        if bucket > bucket_for(len(live), batcher.max_batch):
+            m_upsized.inc()
+        if journal is not None:
+            journal.dispatched([e.request_id for e in live], this_batch,
+                               vnow, phase=1)
+        dispatch_ms = vnow
+        attempt = 0
+        while True:
+            fault = (chaos.take(this_batch, [e.request_id for e in live])
+                     if chaos is not None else None)
+            t0 = timer()
+            try:
+                span_name = "serve.batch" if attempt == 0 else "serve.retry"
+                with span(span_name, batch=this_batch, lanes=bucket,
+                          occupancy=len(live), phase=1,
+                          **({"attempt": attempt} if attempt else {})):
+                    carry_g, run_ms, hit, _, _ = run_entries(
+                        live, compile_key, guidance, bucket, fault=fault)
+                total_ms = (timer() - t0) * 1000.0
+                compile_ms = max(0.0, total_ms - run_ms)
+                break
+            except Exception as exc:  # noqa: BLE001 — classified below
+                vnow += (timer() - t0) * 1000.0
+                kind, reason = _fault_verdict(exc)
+                if kind == faults_mod.TIMEOUT:
+                    _note_timeout(compile_key, bucket)
+                    for e in live:
+                        yield record("timeout", e.request_id,
+                                     arrival_ms=e.arrival_ms, reason=reason,
+                                     batch_id=this_batch)
+                    return
+                if kind == faults_mod.FATAL:
+                    for e in live:
+                        yield record("error", e.request_id,
+                                     arrival_ms=e.arrival_ms,
+                                     reason=f"fatal: {reason}",
+                                     batch_id=this_batch)
+                    fatal_reason[0] = reason
+                    return
+                if kind == faults_mod.TRANSIENT:
+                    if attempt + 1 < policy.max_attempts:
+                        backoff = policy.backoff_ms(
+                            attempt, key=f"batch:{this_batch}")
+                        retries_total += 1
+                        m_retries.inc()
+                        m_backoff.observe(backoff)
+                        vnow += backoff
+                        attempt += 1
+                        recs, live = _live_after_backoff(live)
+                        yield from recs
+                        if not live:
+                            return
+                        continue
+                    for e in live:
+                        yield record(
+                            "error", e.request_id, arrival_ms=e.arrival_ms,
+                            reason=(f"transient fault persisted through "
+                                    f"{policy.max_attempts} attempts: "
+                                    f"{reason}"),
+                            batch_id=this_batch)
+                    return
+                yield from isolate_phase1(live, compile_key, guidance, exc)
+                return
+        vnow += compile_ms + run_ms
+        occupancies.append(len(live))
+        occ_by_phase["phase1"].append(len(live))
+        m_occupancy.labels(phase="phase1").observe(float(len(live)))
+        batch_hits.append(hit)
+        do_handoff(live, carry_g, this_batch, bucket, len(live),
+                   dispatch_ms, compile_ms, run_ms, hit, fault=fault)
+
+    def isolate_phase1(entries, compile_key, guidance,
+                       batch_exc) -> Iterator[dict]:
+        """A phase-1 batch failed: re-run each lane alone; survivors hand
+        off to the phase-2 pool exactly as a healthy batch's lanes do."""
+        nonlocal vnow, batch_index
+        entries = list(entries)
+        for idx, e in enumerate(entries):
+            batch_index += 1
+            m_isolated.inc()
+            bucket = _pick_bucket(1, compile_key, batcher.max_batch, cache)
+            if journal is not None:
+                journal.dispatched([e.request_id], batch_index, vnow,
+                                   phase=1)
+            dispatch_ms = vnow
+            fault = (chaos.take(batch_index, [e.request_id])
+                     if chaos is not None else None)
+            try:
+                t0 = timer()
+                with span("serve.isolate_retry", batch=batch_index,
+                          lanes=bucket, request=e.request_id, phase=1):
+                    carry_g, run_ms, hit, _, _ = run_entries(
+                        [e], compile_key, guidance, bucket, fault=fault)
+                compile_ms = max(0.0, (timer() - t0) * 1000.0 - run_ms)
+            except Exception as exc:  # noqa: BLE001 — classified below
+                vnow += (timer() - t0) * 1000.0
+                kind, reason = _fault_verdict(exc)
+                batch_err = f"{type(batch_exc).__name__}: {batch_exc}"
+                if kind == faults_mod.TIMEOUT:
+                    _note_timeout(compile_key, bucket)
+                    yield record(
+                        "timeout", e.request_id, arrival_ms=e.arrival_ms,
+                        reason=reason, batch_id=batch_index,
+                        batch_error=batch_err, isolated_retry=True)
+                    continue
+                if kind == faults_mod.FATAL:
+                    fatal_reason[0] = reason
+                    for rest in entries[idx:]:
+                        yield record(
+                            "error", rest.request_id,
+                            arrival_ms=rest.arrival_ms,
+                            reason=f"fatal: {reason}", batch_error=batch_err)
+                    return
+                yield record(
+                    "error", e.request_id, arrival_ms=e.arrival_ms,
+                    reason=reason, batch_error=batch_err)
+                continue
+            vnow += compile_ms + run_ms
+            occupancies.append(1)
+            occ_by_phase["phase1"].append(1)
+            m_occupancy.labels(phase="phase1").observe(1.0)
+            batch_hits.append(hit)
+            do_handoff([e], carry_g, batch_index, bucket, 1, dispatch_ms,
+                       compile_ms, run_ms, hit, isolated=True, fault=fault)
+
+    def emit_phase2_lane(e: HandoffEntry, image, this_batch, bucket,
+                         occupancy, dispatch_ms, compile_ms, run_ms, hit,
+                         isolated: bool = False) -> dict:
+        """One gated request completed: assemble its ok record (whole-
+        request latency split + the per-phase `phases` detail) and feed
+        the per-phase stage histograms."""
+        latency = vnow - e.arrival_ms
+        latencies.append(latency)
+        p1 = e.phase1
+        handoff_wait = dispatch_ms - e.handoff_ms
+        phases: dict = {
+            "handoff_wait_ms": handoff_wait,
+            "phase2": {"batch_id": this_batch, "lanes": bucket,
+                       "occupancy": occupancy, "compile_ms": compile_ms,
+                       "run_ms": run_ms, "cache_hit": hit},
+        }
+        stage = m_stage
+        if p1 is not None:
+            phases["phase1"] = dict(p1)
+            stage["queue_wait_ms"].labels(phase="phase1").observe(
+                float(p1["queue_wait_ms"]))
+            stage["compile_ms"].labels(phase="phase1").observe(
+                float(p1["compile_ms"]))
+            stage["run_ms"].labels(phase="phase1").observe(
+                float(p1["run_ms"]))
+        else:
+            phases["phase1"] = {"resumed": True}
+        if e.resumed:
+            phases["resumed"] = True
+        stage["queue_wait_ms"].labels(phase="phase2").observe(handoff_wait)
+        stage["compile_ms"].labels(phase="phase2").observe(compile_ms)
+        stage["run_ms"].labels(phase="phase2").observe(run_ms)
+        stage["total_ms"].labels(phase="gated").observe(latency)
+        extra = {"isolated_retry": True} if isolated else {}
+        return record(
+            "ok", e.request_id, stage_phase=None, images=image,
+            arrival_ms=e.arrival_ms,
+            queue_wait_ms=(p1["queue_wait_ms"] if p1 is not None else 0.0),
+            compile_ms=(p1["compile_ms"] if p1 else 0.0) + compile_ms,
+            run_ms=(p1["run_ms"] if p1 else 0.0) + run_ms,
+            total_ms=latency, batch_id=this_batch, batch_lanes=bucket,
+            batch_occupancy=occupancy,
+            cache_hit=bool(hit and (p1 is None or p1["cache_hit"])),
+            gate_step=e.prepared.gate_step, phases=phases, **extra)
+
+    def dispatch_phase2(batch: Batch) -> Iterator[dict]:
+        nonlocal vnow, batch_index, retries_total
+        live = []
+        for e in batch.entries:
+            if queue.is_cancelled(e.request_id):
+                yield record("cancelled", e.request_id,
+                             arrival_ms=e.arrival_ms,
+                             queue_wait_ms=vnow - e.arrival_ms)
+            elif queue_mod.expired(e, vnow):
+                yield record(
+                    "expired", e.request_id, arrival_ms=e.arrival_ms,
+                    reason=(f"deadline {e.request.deadline_ms}ms passed "
+                            f"during the phase hand-off (waited "
+                            f"{vnow - e.arrival_ms:.1f}ms)"))
+            else:
+                live.append(e)
+        if not live:
+            return
+        batch_index += 1
+        this_batch = batch_index
+        guidance = live[0].request.guidance
+        compile_key = live[0].prepared.phase2_key
+        bucket = _pick_bucket(len(live), compile_key, batcher2.max_batch,
+                              cache)
+        if bucket > bucket_for(len(live), batcher2.max_batch):
+            m_upsized.inc()
+        if journal is not None:
+            journal.dispatched([e.request_id for e in live], this_batch,
+                               vnow, phase=2)
+        dispatch_ms = vnow
+        attempt = 0
+        while True:
+            fault = (chaos.take(this_batch, [e.request_id for e in live])
+                     if chaos is not None else None)
+            t0 = timer()
+            try:
+                span_name = "serve.batch" if attempt == 0 else "serve.retry"
+                with span(span_name, batch=this_batch, lanes=bucket,
+                          occupancy=len(live), phase=2,
+                          **({"attempt": attempt} if attempt else {})):
+                    imgs, run_ms, hit, _, finite = run_entries(
+                        live, compile_key, guidance, bucket, fault=fault)
+                total_ms = (timer() - t0) * 1000.0
+                compile_ms = max(0.0, total_ms - run_ms)
+                break
+            except Exception as exc:  # noqa: BLE001 — classified below
+                vnow += (timer() - t0) * 1000.0
+                kind, reason = _fault_verdict(exc)
+                if kind == faults_mod.TIMEOUT:
+                    _note_timeout(compile_key, bucket)
+                    for e in live:
+                        yield record("timeout", e.request_id,
+                                     arrival_ms=e.arrival_ms, reason=reason,
+                                     batch_id=this_batch)
+                    return
+                if kind == faults_mod.FATAL:
+                    for e in live:
+                        yield record("error", e.request_id,
+                                     arrival_ms=e.arrival_ms,
+                                     reason=f"fatal: {reason}",
+                                     batch_id=this_batch)
+                    fatal_reason[0] = reason
+                    return
+                if kind == faults_mod.TRANSIENT:
+                    if attempt + 1 < policy.max_attempts:
+                        backoff = policy.backoff_ms(
+                            attempt, key=f"batch:{this_batch}")
+                        retries_total += 1
+                        m_retries.inc()
+                        m_backoff.observe(backoff)
+                        vnow += backoff
+                        attempt += 1
+                        recs, live = _live_after_backoff(live)
+                        yield from recs
+                        if not live:
+                            return
+                        continue
+                    for e in live:
+                        yield record(
+                            "error", e.request_id, arrival_ms=e.arrival_ms,
+                            reason=(f"transient fault persisted through "
+                                    f"{policy.max_attempts} attempts: "
+                                    f"{reason}"),
+                            batch_id=this_batch)
+                    return
+                yield from isolate_phase2(live, compile_key, guidance, exc)
+                return
+        vnow += compile_ms + run_ms
+        occupancies.append(len(live))
+        occ_by_phase["phase2"].append(len(live))
+        m_occupancy.labels(phase="phase2").observe(float(len(live)))
+        batch_hits.append(hit)
+        bad = set()
+        if finite is not None:
+            bad = {i for i in range(len(live)) if not bool(finite[i])}
+        if (fault is not None and fault.kind == "nan" and validate_outputs):
+            bad |= {i for i, e in enumerate(live)
+                    if e.request_id in fault.rids}
+        # Lanes whose PHASE-1 dispatch took the nan injection: validation
+        # is a completion-time verdict, so the marker converts them here.
+        bad |= {i for i, e in enumerate(live) if e.nan_injected}
+        lanes = lane_select(imgs, range(len(live)))
+        for i, e in enumerate(live):
+            if i in bad:
+                m_invalid.inc()
+                yield record(
+                    "invalid_output", e.request_id,
+                    arrival_ms=e.arrival_ms,
+                    reason="non-finite values (NaN/Inf) in this lane's "
+                           "latents; image withheld",
+                    batch_id=this_batch, batch_lanes=bucket,
+                    batch_occupancy=len(live))
+                continue
+            yield emit_phase2_lane(e, lanes[i], this_batch, bucket,
+                                   len(live), dispatch_ms, compile_ms,
+                                   run_ms, hit)
+
+    def isolate_phase2(entries, compile_key, guidance,
+                       batch_exc) -> Iterator[dict]:
+        """A phase-2 batch failed: each lane re-runs alone off its own
+        carry; the survivors still complete."""
+        nonlocal vnow, batch_index
+        entries = list(entries)
+        for idx, e in enumerate(entries):
+            batch_index += 1
+            m_isolated.inc()
+            bucket = _pick_bucket(1, compile_key, batcher2.max_batch, cache)
+            if journal is not None:
+                journal.dispatched([e.request_id], batch_index, vnow,
+                                   phase=2)
+            dispatch_ms = vnow
+            fault = (chaos.take(batch_index, [e.request_id])
+                     if chaos is not None else None)
+            try:
+                t0 = timer()
+                with span("serve.isolate_retry", batch=batch_index,
+                          lanes=bucket, request=e.request_id, phase=2):
+                    imgs, run_ms, hit, _, finite = run_entries(
+                        [e], compile_key, guidance, bucket, fault=fault)
+                compile_ms = max(0.0, (timer() - t0) * 1000.0 - run_ms)
+            except Exception as exc:  # noqa: BLE001 — classified below
+                vnow += (timer() - t0) * 1000.0
+                kind, reason = _fault_verdict(exc)
+                batch_err = f"{type(batch_exc).__name__}: {batch_exc}"
+                if kind == faults_mod.TIMEOUT:
+                    _note_timeout(compile_key, bucket)
+                    yield record(
+                        "timeout", e.request_id, arrival_ms=e.arrival_ms,
+                        reason=reason, batch_id=batch_index,
+                        batch_error=batch_err, isolated_retry=True)
+                    continue
+                if kind == faults_mod.FATAL:
+                    fatal_reason[0] = reason
+                    for rest in entries[idx:]:
+                        yield record(
+                            "error", rest.request_id,
+                            arrival_ms=rest.arrival_ms,
+                            reason=f"fatal: {reason}", batch_error=batch_err)
+                    return
+                yield record(
+                    "error", e.request_id, arrival_ms=e.arrival_ms,
+                    reason=reason, batch_error=batch_err)
+                continue
+            vnow += compile_ms + run_ms
+            occupancies.append(1)
+            occ_by_phase["phase2"].append(1)
+            m_occupancy.labels(phase="phase2").observe(1.0)
+            batch_hits.append(hit)
+            if ((finite is not None and not bool(finite[0])) or
+                    e.nan_injected or
+                    (fault is not None and fault.kind == "nan"
+                     and validate_outputs)):
+                m_invalid.inc()
+                yield record(
+                    "invalid_output", e.request_id, arrival_ms=e.arrival_ms,
+                    reason="non-finite values (NaN/Inf) in this lane's "
+                           "latents; image withheld",
+                    batch_id=batch_index, batch_lanes=bucket,
+                    batch_occupancy=1, isolated_retry=True)
+                continue
+            lanes = lane_select(imgs, range(1))
+            yield emit_phase2_lane(e, lanes[0], batch_index, bucket, 1,
+                                   dispatch_ms, compile_ms, run_ms, hit,
+                                   isolated=True)
 
     def update_degradation() -> None:
         """Pressure hysteresis: one level up per sustained-pressure window,
@@ -782,9 +1332,15 @@ def serve_forever(
     def _apply_degrade_level() -> None:
         # Level 2+: smaller flush/padding bucket — shorter head-of-line
         # blocking when deadlines are the binding constraint. The batcher
-        # cap stays within BUCKET_SIZES, preserving the padding contract.
+        # caps stay within BUCKET_SIZES, preserving the padding contract.
+        # Degradation is per-pool: both pools shrink one step below their
+        # own cap, so the phase-2 pool keeps its relative width.
+        shrink = degrade_level >= 2
         batcher.max_batch = (_shrunken_bucket(max_batch, degrade.min_bucket)
-                             if degrade_level >= 2 else max_batch)
+                             if shrink else max_batch)
+        batcher2.max_batch = (
+            _shrunken_bucket(phase2_max_batch, degrade.min_bucket)
+            if shrink else phase2_max_batch)
 
     while True:
         # 1. Admit everything that has arrived by now.
@@ -848,27 +1404,44 @@ def serve_forever(
                             f"{degrade.depth_threshold}"))
             else:
                 batcher.add(entry, vnow)
-        # 3. Flush whatever is due.
+        # 3. Flush whatever is due — phase-2 pool first: finishing
+        # nearly-done requests frees outstanding slots and bounds their
+        # p95 before new phase-1 work starts (the continuous-batching
+        # priority).
+        batches2 = batcher2.ready(vnow)
         batches = batcher.ready(vnow)
-        if not batches:
+        if not batches and not batches2:
             if journal is not None:
                 journal.sync()  # going idle: everything admitted is durable
             events = [t for t in (trace.next_arrival_ms,
-                                  batcher.next_flush_ms()) if t is not None]
+                                  batcher.next_flush_ms(),
+                                  batcher2.next_flush_ms())
+                      if t is not None]
             if events:
                 vnow = max(vnow, min(events))
                 continue
-            batches = batcher.flush_all(vnow)  # trace done: drain the tail
-            if not batches:
+            # Trace done: drain both tails (hand-offs produced by the
+            # phase-1 tail re-enter via the next loop iteration).
+            batches2 = batcher2.flush_all(vnow)
+            batches = batcher.flush_all(vnow)
+            if not batches and not batches2:
                 break
-        for bi, batch in enumerate(batches):
-            yield from dispatch(batch)
+        ordered = ([("phase2", b) for b in batches2]
+                   + [("phase1", b) for b in batches])
+        for bi, (pool, batch) in enumerate(ordered):
+            if pool == "phase2":
+                yield from dispatch_phase2(batch)
+            else:
+                yield from dispatch(batch)
             if fatal_reason[0] is not None:
                 # Fatal fault: drain cleanly — terminal records for every
                 # outstanding request, then the summary. Nothing is left
                 # wedged; a journaled restart re-serves what never ran.
-                leftover = [e for b in batches[bi + 1:] for e in b.entries]
+                leftover = [e for _, b in ordered[bi + 1:]
+                            for e in b.entries]
                 leftover += [e for b in batcher.flush_all(vnow)
+                             for e in b.entries]
+                leftover += [e for b in batcher2.flush_all(vnow)
                              for e in b.entries]
                 leftover += queue.drain()
                 for e in leftover:
@@ -919,6 +1492,23 @@ def serve_forever(
         "watchdog_timeouts": timeouts_total,
         "degrade_transitions": degrade_transitions,
     }
+    if handoffs_total or resumed_handoffs or any(occ_by_phase.values()):
+        # Present only when the disaggregated pools actually ran, so the
+        # single-pool summary stays byte-identical (the disabled-mode
+        # contract covers the record stream end to end).
+        def _pool(occ: List[int]) -> dict:
+            return {"batches": len(occ),
+                    "mean_occupancy": (sum(occ) / len(occ)) if occ else 0.0}
+
+        summary["phases"] = {
+            "handoffs": handoffs_total,
+            "resumed_handoffs": resumed_handoffs,
+            "phase1": _pool(occ_by_phase["phase1"]),
+            "phase2": {**_pool(occ_by_phase["phase2"]),
+                       "pack_p50": _percentile(
+                           sorted(occ_by_phase["phase2"]), 50)},
+            "phase2_max_batch": phase2_max_batch,
+        }
     if replay_info is not None:
         summary["replay"] = replay_info
     if fatal_reason[0] is not None:

@@ -1,0 +1,210 @@
+"""Phase hand-off: the unit of transfer between the two program pools.
+
+Phase-disaggregated continuous batching splits a gated request's
+trajectory across two separately scheduled pools: a phase-1 program (full
+CFG + controller hooks, steps ``[0, gate)``) produces a per-lane
+:class:`~p2p_tpu.engine.sampler.PhaseCarry` — ``AttnCache`` + latent + CFG
+residual + multistep scheduler state (+ the frozen store), ONE pytree with
+a pinned treedef — and a phase-2 program (single-branch U-Net off the
+cache) consumes it. This module is everything that crosses the boundary:
+
+- :class:`HandoffEntry` — a queued-and-admitted request whose phase 1 has
+  completed, waiting in the phase-2 batcher with its hand-off unit
+  (``{"carry": PhaseCarry, "ctx": encoded cond context}`` from the real
+  runners — the context rides along so phase 2 never re-runs the text
+  encoder). The unit is *opaque* to the engine loop (tests hand fake
+  runners fake carries); only the runners and the spill path touch its
+  leaves.
+- :func:`lane_carries` / :func:`stack_carries` — split a pool program's
+  ``(G, ...)``-leading carry into per-lane units and re-pack lanes from
+  *different* phase-1 batches into one phase-2 batch (padding replicates
+  the last real lane, mirroring the batcher's input-padding contract).
+- :func:`spill_carry` / :func:`load_carry` / :func:`carry_template` — the
+  journal's crash-replay persistence: a carry round-trips through an
+  ``.npz`` next to the WAL, validated leaf-by-leaf against the treedef the
+  *request* implies, so a restart resumes the request in phase 2 instead
+  of re-running phase 1 — and a corrupt/mismatched spill falls back to
+  phase 1 instead of feeding a wrong-shaped carry to a compiled program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, List, Optional
+
+from .queue import Entry
+
+
+@dataclasses.dataclass
+class HandoffEntry:
+    """One request between its phases: the original admission entry plus
+    the per-lane carry its phase-1 batch produced. Exposes the same
+    surface the batcher/queue code reads off an :class:`Entry`, so the
+    phase-2 pool rides the identical machinery (aging, deadlines,
+    cancellation, priority ordering)."""
+
+    entry: Entry
+    carry: Any                      # per-lane carry (opaque to the engine)
+    handoff_ms: float               # virtual time phase 1 completed
+    phase1: Optional[dict] = None   # phase-1 latency/batch facts for the record
+    resumed: bool = False           # reloaded from a journal spill on replay
+    #: A chaos 'nan' fault hit this lane's phase-1 dispatch: validation is
+    #: a completion-time verdict, so the injection rides the hand-off and
+    #: converts the lane to `invalid_output` at phase 2 — matching the
+    #: monolithic engine, where the same injection poisons the one batch.
+    nan_injected: bool = False
+
+    @property
+    def prepared(self):
+        return self.entry.prepared
+
+    @property
+    def request(self):
+        return self.entry.request
+
+    @property
+    def request_id(self) -> str:
+        return self.entry.request_id
+
+    @property
+    def arrival_ms(self) -> float:
+        return self.entry.arrival_ms
+
+    @property
+    def seq(self) -> int:
+        return self.entry.seq
+
+    @property
+    def deadline_at(self) -> Optional[float]:
+        return self.entry.deadline_at
+
+
+def lane_carries(carry: Any, n: int) -> List[Any]:
+    """Split a pool program's carry (leaves with a leading G axis) into the
+    first ``n`` per-lane carries — the hand-off units. Pure tree indexing:
+    works on real :class:`PhaseCarry` pytrees and on whatever fake carry a
+    test runner returns, as long as leaves index on axis 0."""
+    import jax
+
+    return [jax.tree_util.tree_map(lambda x, i=i: x[i], carry)
+            for i in range(n)]
+
+
+def stack_carries(carries: List[Any], bucket: int) -> Any:
+    """Re-pack per-lane carries into a phase-2 batch of ``bucket`` lanes,
+    replicating the last real carry into the padding lanes (the same
+    padding contract as the input batcher: padded lanes are masked out of
+    results by ``lane_select``)."""
+    import jax
+    import jax.numpy as jnp
+
+    carries = list(carries)
+    while len(carries) < bucket:
+        carries.append(carries[-1])
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *carries)
+
+
+# ---------------------------------------------------------------------------
+# Journal spill: crash-replay resumes in phase 2
+# ---------------------------------------------------------------------------
+
+
+def carry_template(pipe, prep):
+    """The hand-off unit this request's phase-1 runner produces — derived
+    from the *request* (shapes only, zero-valued), never from a live carry.
+    ``{"carry": PhaseCarry, "ctx": (B, L, D) cond context}``: the encoded
+    conditional half rides the hand-off so phase 2 (and a journal-resumed
+    lane) never re-runs the text encoder. This is the pinned-treedef
+    source :func:`load_carry` validates a spill against: the spec a spill
+    must match is what the phase-2 program was compiled for, which the
+    request alone determines."""
+    import jax.numpy as jnp
+
+    from ..controllers.base import init_store_state
+    from ..engine.sampler import PhaseCarry
+    from ..models.config import unet_layout
+    from ..models.unet import init_attn_cache
+    from ..ops import schedulers as sched_mod
+
+    b = len(prep.request.prompts)
+    cfg = pipe.config
+    layout = unet_layout(cfg.unet)
+    lat = jnp.zeros((b,) + pipe.latent_shape, jnp.float32)
+    ctrl = prep.controller
+    state = (init_store_state(layout, b)
+             if (ctrl is not None and ctrl.needs_store) else ())
+    carry = PhaseCarry(
+        latents=lat,
+        resid=jnp.zeros_like(lat),
+        cache=init_attn_cache(layout, b, dtype=lat.dtype),
+        ms=sched_mod.init_multistep_state(prep.request.scheduler, lat.shape,
+                                          lat.dtype),
+        state=state)
+    ctx = jnp.zeros((b, cfg.unet.context_len, cfg.unet.context_dim),
+                    jnp.float32)
+    return {"carry": carry, "ctx": ctx}
+
+
+def spill_carry(carry: Any, path: str) -> str:
+    """Persist one per-lane carry as an ``.npz`` (leaves in flatten order);
+    returns the carry's pinned spec (``engine.sampler.carry_spec``) for the
+    journal's ``handoff`` record. Written via a temp file + rename so a
+    crash mid-write leaves either the old spill or none — never a torn
+    file that parses."""
+    import jax
+    import numpy as np
+
+    from ..engine.sampler import carry_spec
+
+    leaves = jax.tree_util.tree_flatten(carry)[0]
+    host = {f"leaf_{i}": np.asarray(jax.device_get(x))
+            for i, x in enumerate(leaves)}
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **host)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return carry_spec(carry)
+
+
+def load_carry(path: str, template: Any) -> Any:
+    """Load a spilled carry, validated leaf-by-leaf (count, shape, dtype)
+    against ``template`` (from :func:`carry_template`). Raises
+    ``ValueError`` on any mismatch or unreadable file — the caller falls
+    back to re-running phase 1 rather than feeding a compiled program a
+    carry it was not built for. Leaves are staged back to device
+    explicitly (``stage_host``) so a resumed lane dispatches as
+    transfer-guard-clean as a fresh one."""
+    import jax
+    import numpy as np
+
+    from ..engine.sampler import stage_host
+
+    try:
+        data = np.load(path)
+    except Exception as e:  # noqa: BLE001 — any unreadable spill is a miss
+        raise ValueError(f"unreadable carry spill {path!r}: {e}")
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    leaves = []
+    for i, tl in enumerate(t_leaves):
+        name = f"leaf_{i}"
+        if name not in data:
+            raise ValueError(f"carry spill {path!r} missing {name} "
+                             f"(expected {len(t_leaves)} leaves)")
+        arr = data[name]
+        if tuple(arr.shape) != tuple(tl.shape) or \
+                str(arr.dtype) != str(tl.dtype):
+            raise ValueError(
+                f"carry spill {path!r} leaf {i}: {arr.shape}/{arr.dtype} "
+                f"does not match the request's pinned spec "
+                f"{tuple(tl.shape)}/{tl.dtype}")
+        leaves.append(stage_host(arr))
+    if len(data.files) > len(t_leaves):
+        raise ValueError(f"carry spill {path!r} has {len(data.files)} "
+                         f"leaves, expected {len(t_leaves)}")
+    return jax.tree_util.tree_unflatten(treedef, leaves)

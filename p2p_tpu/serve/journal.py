@@ -5,6 +5,10 @@ writes one JSON line per request-state transition —
 
 - ``admitted``   — the full request dict, at admission (before any compute)
 - ``dispatched`` — the request ids of a batch, when it is handed to a runner
+- ``handoff``    — a gated request crossed the phase boundary: its phase-1
+  carry was spilled to a sidecar ``.npz`` (under ``<wal>.carry/``) whose
+  path + pinned treedef spec ride the record — a restart resumes the
+  request in phase 2 off the spill instead of re-running phase 1
 - ``terminal``   — request id + final status, when the record is emitted
 - ``event``      — loop-level transitions (degradation level changes)
 
@@ -36,6 +40,7 @@ from typing import Dict, List
 
 ADMITTED = "admitted"
 DISPATCHED = "dispatched"
+HANDOFF = "handoff"
 TERMINAL = "terminal"
 EVENT = "event"
 
@@ -51,6 +56,9 @@ class ReplayState:
 
     pending: List[dict] = dataclasses.field(default_factory=list)
     terminal: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: request id -> its last ``handoff`` record (carry spill path + spec):
+    #: a pending id present here resumes in phase 2 when the spill loads.
+    handoffs: Dict[str, dict] = dataclasses.field(default_factory=dict)
     skipped_corrupt: int = 0
     duplicate_terminals: int = 0
 
@@ -102,6 +110,12 @@ def replay(path: str) -> ReplayState:
                     state.duplicate_terminals += 1
                 else:
                     state.terminal[rid] = status
+            elif kind == HANDOFF:
+                rid = rec.get("id")
+                if not rid or not rec.get("carry_path"):
+                    state.skipped_corrupt += 1
+                    continue
+                state.handoffs[rid] = rec  # last hand-off wins (retries)
             elif kind in (DISPATCHED, EVENT):
                 pass  # informational; replay keys off admitted/terminal
             else:
@@ -136,9 +150,39 @@ class Journal:
         self._append({"type": ADMITTED, "request": request_dict,
                       "vnow_ms": round(vnow, 3)})
 
-    def dispatched(self, request_ids, batch_index: int, vnow: float) -> None:
-        self._append({"type": DISPATCHED, "ids": list(request_ids),
-                      "batch": batch_index, "vnow_ms": round(vnow, 3)})
+    def dispatched(self, request_ids, batch_index: int, vnow: float,
+                   phase: int = 0) -> None:
+        rec = {"type": DISPATCHED, "ids": list(request_ids),
+               "batch": batch_index, "vnow_ms": round(vnow, 3)}
+        if phase:
+            rec["phase"] = phase
+        self._append(rec)
+
+    def handoff(self, request_id: str, vnow: float, carry_path: str,
+                spec: str) -> None:
+        """One gated request crossed the phase boundary; its carry spill at
+        ``carry_path`` (already durably written) matches ``spec``."""
+        self._append({"type": HANDOFF, "id": request_id,
+                      "carry_path": carry_path, "spec": spec,
+                      "vnow_ms": round(vnow, 3)})
+
+    def carry_path(self, request_id: str) -> str:
+        """Where this WAL spills a request's hand-off carry: a sidecar dir
+        next to the log, one ``.npz`` per request id."""
+        import hashlib
+
+        # Request ids are caller-chosen free text: hash them into the
+        # filename so a hostile/awkward id ("../x", 300 chars) cannot
+        # escape or break the sidecar dir; the id itself stays in the WAL.
+        digest = hashlib.sha256(request_id.encode()).hexdigest()[:24]
+        return os.path.join(self.path + ".carry", digest + ".npz")
+
+    def discard_carry(self, request_id: str) -> None:
+        """Drop a terminal request's spill (hygiene; best-effort)."""
+        try:
+            os.remove(self.carry_path(request_id))
+        except OSError:
+            pass
 
     def terminal(self, request_id: str, status: str, vnow: float) -> None:
         self._append({"type": TERMINAL, "id": request_id, "status": status,

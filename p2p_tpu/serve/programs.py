@@ -235,12 +235,175 @@ class SweepRunner:
         return jax.device_get(imgs)
 
 
+class Phase1Runner(SweepRunner):
+    """Phase-1 POOL runner: the same inputs as a monolithic sweep (CFG
+    context halves, shared-seed latents, full controller), but the program
+    runs only steps ``[0, gate)`` and returns the per-group
+    :class:`~p2p_tpu.engine.sampler.PhaseCarry` (leaves with a leading
+    ``bucket`` axis) instead of images — the hand-off units the engine
+    splits per lane and feeds to the separately scheduled phase-2 pool."""
+
+    def __init__(self, pipe, compile_key: Tuple, bucket: int,
+                 progress: bool = False, validate: bool = False,
+                 heartbeat: bool = False):
+        # Strip the "phase1" pool tag; the rest is the monolithic key
+        # layout SweepRunner already parses.
+        super().__init__(pipe, compile_key[1:], bucket, progress=progress,
+                         validate=validate, heartbeat=heartbeat)
+
+    def _run(self, ctx, lat, ctrl, guidance: float):
+        from ..parallel.sweep import sweep_phase1
+
+        return sweep_phase1(self.pipe, ctx, lat, ctrl, num_steps=self.steps,
+                            guidance_scale=guidance,
+                            scheduler=self.scheduler, gate=self.gate_step,
+                            progress=self.progress, metrics=self.heartbeat)
+
+    def warm(self, entries) -> None:
+        import jax
+
+        ctx, lat, ctrl = self._inputs(entries, zeros=True)
+        jax.block_until_ready(self._run(ctx, lat, ctrl, guidance=1.0))
+
+    def __call__(self, entries, guidance: float):
+        import jax
+
+        ctx, lat, ctrl = self._inputs(entries)
+        carry = self._run(ctx, lat, ctrl, guidance)
+        # The hand-off unit pairs the sampler carry with the already-
+        # encoded cond context half, so phase 2 never re-runs the text
+        # encoder for work phase 1 already did (and a journal-resumed
+        # lane needs no encoder at all). Everything STAYS on device (only
+        # a journal spill fetches it to host) — but the dispatch is
+        # synchronized so run_ms measures execution, not async enqueue.
+        return jax.block_until_ready(
+            {"carry": carry, "ctx": ctx[:, self.group_batch:]})
+
+
+class Phase2Runner:
+    """Phase-2 POOL runner: packs hand-off carries from *different*
+    requests (different phase-1 batches, even different edit modes — the
+    phase-2 compile key reduces the controller to what survives the gate)
+    into one wide single-branch batch: steps ``[gate, S)`` off each lane's
+    ``AttnCache`` + residual, then the VAE decode.
+
+    Every lane's carry is validated against the request's pinned treedef
+    spec (``engine.sampler.carry_spec`` vs :func:`handoff.carry_template`)
+    before it touches the compiled program — a mismatched hand-off is a
+    hard error at dispatch, not an XLA shape failure three layers down."""
+
+    def __init__(self, pipe, compile_key: Tuple, bucket: int,
+                 progress: bool = False, validate: bool = False,
+                 heartbeat: bool = False):
+        self.pipe = pipe
+        (_, _, self.steps, self.scheduler, self.gate_step, self.group_batch,
+         _) = compile_key
+        self.bucket = bucket
+        self.progress = progress
+        self.validate = validate
+        self.heartbeat = heartbeat
+        self.last_lane_finite = None
+        self._expected_spec = None
+
+    def _spec_for(self, prep) -> str:
+        from ..engine.sampler import carry_spec
+
+        from .handoff import carry_template
+
+        if self._expected_spec is None:
+            self._expected_spec = carry_spec(carry_template(self.pipe, prep))
+        return self._expected_spec
+
+    def _inputs(self, entries, zeros: bool = False):
+        import jax
+        import jax.numpy as jnp
+
+        from ..engine.sampler import carry_spec, phase2_controller
+
+        from .handoff import stack_carries
+
+        carries, ctrls = [], []
+        for e in entries:
+            want = self._spec_for(e.prepared)
+            got = carry_spec(e.carry)
+            if got != want:
+                raise ValueError(
+                    f"hand-off carry for request {e.request_id!r} does not "
+                    f"match its pinned treedef spec:\n  got  {got}\n"
+                    f"  want {want}")
+            carries.append(e.carry)
+            ctrls.append(phase2_controller(e.prepared.controller))
+        # Pack the hand-off units (sampler carry + encoded cond context)
+        # into one phase-2 batch; padding replicates the last real lane.
+        packed = stack_carries(carries, self.bucket)
+        ctx, carry = packed["ctx"], packed["carry"]
+        while len(ctrls) < self.bucket:
+            ctrls.append(ctrls[-1])
+        ctrl = (None if ctrls[0] is None else
+                jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ctrls))
+        if zeros:
+            ctx = jnp.zeros_like(ctx)
+            carry = jax.tree_util.tree_map(jnp.zeros_like, carry)
+        return ctx, carry, ctrl
+
+    def _run(self, ctx, carry, ctrl, guidance: float):
+        from ..parallel.sweep import sweep_phase2
+
+        return sweep_phase2(self.pipe, ctx, carry, ctrl,
+                            num_steps=self.steps, guidance_scale=guidance,
+                            scheduler=self.scheduler, gate=self.gate_step,
+                            progress=self.progress, metrics=self.heartbeat)
+
+    def warm(self, entries) -> None:
+        """Compile-ahead off zero inputs shaped by the request alone
+        (``handoff.carry_template``), so the phase-2 program can prewarm
+        before any phase-1 batch has produced a real carry."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..engine.sampler import phase2_controller
+
+        from .handoff import carry_template
+
+        prep = entries[0].prepared
+        template = carry_template(self.pipe, prep)
+        lead = jax.tree_util.tree_map(
+            lambda x: jnp.zeros((self.bucket,) + tuple(x.shape), x.dtype),
+            template)
+        ctx, carry = lead["ctx"], lead["carry"]
+        ctrl = phase2_controller(prep.controller)
+        ctrl_g = (None if ctrl is None else jax.tree_util.tree_map(
+            lambda x: jnp.stack([x] * self.bucket), ctrl))
+        imgs, _ = self._run(ctx, carry, ctrl_g, guidance=1.0)
+        jax.device_get(imgs)
+
+    def __call__(self, entries, guidance: float):
+        import jax
+
+        ctx, carry, ctrl = self._inputs(entries)
+        imgs, lats = self._run(ctx, carry, ctrl, guidance)
+        if self.validate:
+            from ..engine.sampler import lane_finite
+
+            self.last_lane_finite = jax.device_get(lane_finite(lats))
+        return jax.device_get(imgs)
+
+
 def default_runner_factory(pipe, progress: bool = False,
                            validate: bool = False, heartbeat: bool = False):
-    """The engine's default ``runner_factory``: real sweeps on ``pipe``."""
+    """The engine's default ``runner_factory``: real sweeps on ``pipe``.
+    Dispatches on the compile key's pool tag — ``("phase1", ...)`` /
+    ``("phase2", ...)`` keys build the disaggregated pool runners,
+    everything else the monolithic :class:`SweepRunner` (ungated traffic's
+    bitwise-unchanged fast path)."""
 
-    def make(compile_key: Tuple, bucket: int) -> SweepRunner:
-        return SweepRunner(pipe, compile_key, bucket, progress=progress,
-                           validate=validate, heartbeat=heartbeat)
+    def make(compile_key: Tuple, bucket: int):
+        kw = dict(progress=progress, validate=validate, heartbeat=heartbeat)
+        tag = compile_key[0] if compile_key else None
+        if tag == "phase1":
+            return Phase1Runner(pipe, compile_key, bucket, **kw)
+        if tag == "phase2":
+            return Phase2Runner(pipe, compile_key, bucket, **kw)
+        return SweepRunner(pipe, compile_key, bucket, **kw)
 
     return make

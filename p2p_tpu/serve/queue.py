@@ -126,6 +126,21 @@ class AdmissionQueue:
         self._update_gauges()
         return entry
 
+    def admit_inflight(self, prepared: PreparedRequest,
+                       now_ms: float) -> Entry:
+        """Admit a request directly into the *outstanding* set without
+        queuing it for the batcher — the crash-replay path for a request
+        whose journaled hand-off resumes it mid-pipeline (phase 2): it
+        must hold a capacity slot and stay cancellable, but it re-enters
+        at the hand-off batcher, not at admission. Same backpressure and
+        duplicate-id rules as :meth:`submit`. (Popped by identity, not
+        ``list.remove``: Entry equality would compare controller array
+        leaves.)"""
+        entry = self.submit(prepared, now_ms)
+        self._waiting = [e for e in self._waiting if e is not entry]
+        self._update_gauges()
+        return entry
+
     def cancel(self, request_id: str) -> bool:
         """Mark an outstanding request cancelled. Returns False for an
         unknown/already-resolved id (the engine surfaces that as a no-op

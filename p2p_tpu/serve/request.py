@@ -14,7 +14,7 @@ costs queue capacity — the same controller factory and gate checks the CLI
 path uses (``cli.controller_from_opts`` / ``resolve_gate``), so the serve
 surface can never accept a spec the direct surface would refuse.
 
-:func:`prepare` also derives the two keys the batcher runs on:
+:func:`prepare` also derives the keys the batcher runs on:
 
 - ``compile_key`` — everything that changes the XLA program: steps,
   scheduler kind, resolved gate step, group batch (1 or 2 prompts), and the
@@ -23,6 +23,21 @@ surface can never accept a spec the direct surface would refuse.
 - ``batch_key`` — ``compile_key`` plus the values that are traced but
   *shared* across a sweep call (guidance scale): requests may share a
   compiled program yet not a batch.
+
+Gated requests (resolved gate step < scan length) additionally carry the
+**per-phase** keys of the disaggregated program pools:
+
+- ``phase1_key`` — the phase-1 pool program (full CFG + controller hooks,
+  steps ``[0, gate)``, returns the hand-off carry): the monolithic
+  compile key behind a ``"phase1"`` tag — every component shapes phase 1.
+- ``phase2_key`` — the phase-2 pool program (single-branch U-Net off the
+  carry, steps ``[gate, S)`` + decode): the controller component is the
+  *phase-2 slice* (``engine.sampler.phase2_controller``) — attention-edit
+  structure is gone past the gate, so e.g. ``replace`` and ``refine``
+  edits share ONE phase-2 program and their lanes pack together.
+- ``phase2_batch_key`` — ``phase2_key`` + guidance, the phase-2 pool's
+  batching key: lanes from *different requests* (different phase-1
+  batches, even different edit modes) co-batch here.
 """
 
 from __future__ import annotations
@@ -146,7 +161,7 @@ def controller_signature(controller) -> Tuple:
 @dataclasses.dataclass(frozen=True)
 class PreparedRequest:
     """A validated request bound to a pipeline: controller built, gate
-    resolved, batching keys derived."""
+    resolved, batching keys derived (monolithic + per-phase pool keys)."""
 
     request: Request
     controller: Any
@@ -154,6 +169,15 @@ class PreparedRequest:
     scan_steps: int
     compile_key: Tuple
     batch_key: Tuple
+    phase1_key: Optional[Tuple] = None      # None = ungated (single-pool)
+    phase2_key: Optional[Tuple] = None
+    phase2_batch_key: Optional[Tuple] = None
+
+    @property
+    def gated(self) -> bool:
+        """Does this request cross the phase gate (and therefore the
+        hand-off) when served through the disaggregated pools?"""
+        return self.gate_step < self.scan_steps
 
 
 def prepare(req: Request, pipe) -> PreparedRequest:
@@ -186,6 +210,23 @@ def prepare(req: Request, pipe) -> PreparedRequest:
     compile_key = (pipe.config.name, req.steps, req.scheduler, gate_step,
                    len(req.prompts), controller_signature(controller))
     batch_key = compile_key + (float(req.guidance),)
+    phase1_key = phase2_key = phase2_batch_key = None
+    if gate_step < scan_steps:
+        from ..engine.sampler import phase2_controller
+
+        # Phase 1 is shaped by everything the monolithic program is; phase 2
+        # only by what survives the gate — the reduced controller slice.
+        # Conservative components (steps AND gate) stay in both keys: the
+        # compile-key completeness sweep (analysis.compile_key) guards both
+        # directions per field, and a gate change that altered a phase
+        # program without its key would be cache poisoning.
+        phase1_key = ("phase1",) + compile_key
+        phase2_key = ("phase2", pipe.config.name, req.steps, req.scheduler,
+                      gate_step, len(req.prompts),
+                      controller_signature(phase2_controller(controller)))
+        phase2_batch_key = phase2_key + (float(req.guidance),)
     return PreparedRequest(request=req, controller=controller,
                            gate_step=gate_step, scan_steps=scan_steps,
-                           compile_key=compile_key, batch_key=batch_key)
+                           compile_key=compile_key, batch_key=batch_key,
+                           phase1_key=phase1_key, phase2_key=phase2_key,
+                           phase2_batch_key=phase2_batch_key)

@@ -648,6 +648,77 @@ def test_compile_key_sweep_catches_masked_field(tiny_pipe):
     assert by["steps"].ok    # steps still present in the masked key
 
 
+def test_phase_key_sweep_passes_and_pools_across_modes(tiny_pipe):
+    """ISSUE 6: the split per-phase pool keys hold both directions on the
+    real schema — and prove the pooling claim: `mode` changes the phase-1
+    program+key but neither the phase-2 program nor its key (replace and
+    refine edits share one phase-2 pool)."""
+    from p2p_tpu.analysis.compile_key import check_phase_keys
+
+    verdicts = check_phase_keys(
+        tiny_pipe, fields=["gate", "steps", "mode", "seed"])
+    assert all(v.ok for v in verdicts), [v.format() for v in verdicts]
+    by = {v.field: v for v in verdicts}
+    for phase in ("phase1", "phase2"):
+        assert by[f"gate@{phase}"].program_changed
+        assert by[f"gate@{phase}"].key_changed
+        assert not by[f"seed@{phase}"].program_changed
+    assert by["mode@phase1"].program_changed and \
+        by["mode@phase1"].key_changed
+    assert not by["mode@phase2"].program_changed
+    assert not by["mode@phase2"].key_changed
+
+
+def test_phase_key_sweep_catches_masked_gate(tiny_pipe):
+    """THE hand-off regression (ISSUE 6 satellite): a gate-position change
+    that alters the phase-2 program but not its key must be a hard error
+    — pool-cache poisoning would serve a request the wrong tail program."""
+    from p2p_tpu.analysis.compile_key import check_phase_keys
+
+    def masked_key2(prep):
+        tag, name, steps, sched, _gate, lanes, sig = prep.phase2_key
+        return (tag, name, steps, sched, lanes, sig)
+
+    verdicts = check_phase_keys(tiny_pipe, key2_fn=masked_key2,
+                                fields=["gate", "steps"])
+    by = {v.field: v for v in verdicts}
+    assert not by["gate@phase2"].ok
+    assert "poisoning" in by["gate@phase2"].problem
+    assert by["gate@phase1"].ok       # phase-1 key untouched
+    assert by["steps@phase2"].ok      # steps still present in the mask
+
+
+def test_pool_footprint_contract_fires_on_cfg_doubled_phase2(tiny_pipe):
+    """The paired pool contract: a phase-2 'pool program' that still
+    carries the CFG-doubled batch (e.g. someone wires the phase-1 program
+    in for both pools) must fail phase2-footprint."""
+    from p2p_tpu.analysis.contracts import (GATE, _trace_sweep_phase1,
+                                            _trace_sweep_phase2,
+                                            check_pool_footprint)
+    from p2p_tpu.analysis.contracts import _edit_controller
+
+    ctrl = _edit_controller(tiny_pipe)
+    p1 = _trace_sweep_phase1(tiny_pipe, ctrl, bucket=1, gate=GATE,
+                             metrics=False)
+    p2 = _trace_sweep_phase2(tiny_pipe, ctrl, bucket=1, gate=GATE,
+                             metrics=False)
+    ok = check_pool_footprint([
+        _program("serve/phase1-bucket1", p1, gate=GATE, lead_dims=(1,)),
+        _program("serve/phase2-bucket1", p2, gate=GATE, lead_dims=(1,))])
+    assert len(ok) == 1 and ok[0].ok, ok[0].format()
+    # Seeded violation: the phase-1 program posing as the phase-2 pool.
+    bad = check_pool_footprint([
+        _program("serve/phase1-bucket1", p1, gate=GATE, lead_dims=(1,)),
+        _program("serve/phase2-bucket1", p1, gate=GATE, lead_dims=(1,))])
+    assert len(bad) == 1 and not bad[0].ok
+    assert "2B tensors" in bad[0].detail or "not smaller" in bad[0].detail
+    # A missing twin is an error, not a silent skip.
+    orphan = check_pool_footprint([
+        _program("serve/phase1-bucket1", p1, gate=GATE, lead_dims=(1,))])
+    assert len(orphan) == 1 and not orphan[0].ok
+    assert "no phase-2 twin" in orphan[0].detail
+
+
 def test_compile_key_sweep_refuses_uncovered_schema_fields(tiny_pipe,
                                                            monkeypatch):
     # A Request field with no sweep variant must be a hard error — new

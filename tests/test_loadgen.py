@@ -63,6 +63,42 @@ def test_distinct_keys_and_optional_fields():
                for d in trace)
 
 
+def test_gate_mix_schema_and_determinism():
+    """--gate-mix pins (ISSUE 6): the mix draws per-request gates from the
+    trace seed without perturbing arrivals or seeds, 'off' entries omit
+    the field entirely, and the spec parser round-trips the documented
+    syntax."""
+    lg = _loadgen()
+    assert lg.parse_gate_mix("0.5:2,off:1,auto:1") == [
+        (0.5, 2.0), (None, 1.0), ("auto", 1.0)]
+    assert lg.parse_gate_mix("0.5") == [(0.5, 1.0)]      # bare = weight 1
+    assert lg.parse_gate_mix("3:1") == [(3, 1.0)]        # int = step index
+    mix = lg.parse_gate_mix("0.5:1,off:1")
+    base = lg.generate_trace(32, seed=5, steps=4)
+    mixed = lg.generate_trace(32, seed=5, steps=4, gate_mix=mix)
+    again = lg.generate_trace(32, seed=5, steps=4, gate_mix=mix)
+    assert mixed == again                                 # deterministic
+    # Arrivals and seeds are byte-identical to the no-mix trace: the gate
+    # draws ride the same RNG *after* each seed draw.
+    for b, m in zip(base, mixed):
+        assert {k: v for k, v in m.items() if k != "gate"} == b
+    gates = [m.get("gate") for m in mixed]
+    assert set(gates) == {0.5, None}                      # both sides drawn
+    # An all-'off' mix is the preserved default: no gate field anywhere.
+    off = lg.generate_trace(8, seed=5, steps=4,
+                            gate_mix=lg.parse_gate_mix("off"))
+    assert off == lg.generate_trace(8, seed=5, steps=4)
+    # A gated trace is valid serve schema and round-trips prepare()'s gate.
+    from p2p_tpu.serve import Request
+
+    reqs = [Request.from_dict(d) for d in mixed]
+    assert {r.gate for r in reqs} == {0.5, None}
+    with pytest.raises(ValueError, match="weight must be positive"):
+        lg.parse_gate_mix("0.5:0")
+    with pytest.raises(ValueError, match="empty gate mix"):
+        lg.parse_gate_mix(" , ")
+
+
 def test_validation_errors():
     lg = _loadgen()
     with pytest.raises(ValueError, match="n must be"):

@@ -222,14 +222,16 @@ def test_serve_metrics_match_record_stream(tiny_pipe):
     assert sample("serve_requests_total", status="ok")["value"] == len(oks)
     assert sample("serve_admitted_total")["value"] == len(oks)
     # Every ok record contributed one observation per stage histogram, and
-    # the histogram sums equal the record-stream sums.
+    # the histogram sums equal the record-stream sums. Single-pool traffic
+    # lands under the phase="mono" label (the phase-disaggregated pools
+    # observe phase1/phase2 children instead).
     for metric, field in (("serve_queue_wait_ms", "queue_wait_ms"),
                           ("serve_run_ms", "run_ms"),
                           ("serve_request_total_ms", "total_ms")):
-        s = sample(metric)
+        s = sample(metric, phase="mono")
         assert s["count"] == len(oks)
         assert s["sum"] == pytest.approx(sum(r[field] for r in oks))
-    occ = sample("serve_batch_occupancy")
+    occ = sample("serve_batch_occupancy", phase="mono")
     assert occ["count"] == summary["n_batches"]
     assert occ["sum"] == pytest.approx(
         summary["mean_batch_occupancy"] * summary["n_batches"])
@@ -249,7 +251,7 @@ def test_serve_summary_percentiles_reconcile_within_one_bucket(tiny_pipe):
     reg.reset()
     summary = _serve_fixture(tiny_pipe)[-1]
     fam = reg.get("serve_request_total_ms")
-    hist = fam.labels()
+    hist = fam.labels(phase="mono")
     for q, raw in ((0.5, summary["p50_ms"]), (0.95, summary["p95_ms"])):
         est = hist.quantile(q)
         assert abs(hist.bucket_index(est) - hist.bucket_index(raw)) <= 1, \
@@ -380,7 +382,7 @@ def test_poisoned_batch_occupancy_reconciles_with_summary(tiny_pipe):
                        max_wait_ms=1.0)
     summary = recs[-1]
     assert summary["counts"]["error"] == 1      # the poisoned lane fails alone
-    occ = reg.get("serve_batch_occupancy").labels()
+    occ = reg.get("serve_batch_occupancy").labels(phase="mono")
     assert occ.count == summary["n_batches"]
     assert occ.sum == pytest.approx(
         summary["mean_batch_occupancy"] * summary["n_batches"])

@@ -606,19 +606,30 @@ def test_cli_serve_end_to_end(tmp_path):
             "steps": 2}) + "\n")
         f.write(json.dumps({
             "request_id": "cli-1", "prompt": "a cat", "steps": 2}) + "\n")
+        # A gated request: rides the phase-disaggregated pools (ISSUE 6),
+        # exercising the hand-off + --phase2-max-batch through the CLI.
+        f.write(json.dumps({
+            "request_id": "cli-2", "prompt": "a cat", "steps": 2,
+            "gate": 0.5}) + "\n")
     results = tmp_path / "results.jsonl"
     out_dir = tmp_path / "imgs"
     assert main(["serve", "--quiet", "--requests", str(trace),
                  "--results", str(results), "--out-dir", str(out_dir),
-                 "--max-batch", "2", "--max-wait-ms", "5"]) == 0
+                 "--max-batch", "2", "--max-wait-ms", "5",
+                 "--phase2-max-batch", "2"]) == 0
     recs = [json.loads(l) for l in open(results)]
     by = _by_status(recs)
-    assert sorted(r["request_id"] for r in by["ok"]) == ["cli-0", "cli-1"]
+    assert sorted(r["request_id"] for r in by["ok"]) == ["cli-0", "cli-1",
+                                                         "cli-2"]
     assert len(by["summary"]) == 1
+    (gated,) = [r for r in by["ok"] if r["request_id"] == "cli-2"]
+    assert gated["gate_step"] == 1 and gated["phases"]["handoff_wait_ms"] >= 0
+    assert by["summary"][0]["phases"]["handoffs"] == 1
     # Edit lanes use the y/y_hat naming; generation a bare <id>.png.
     assert os.path.exists(out_dir / "cli-0_y.png")
     assert os.path.exists(out_dir / "cli-0_y_hat.png")
     assert os.path.exists(out_dir / "cli-1.png")
+    assert os.path.exists(out_dir / "cli-2.png")
     assert all("images" not in r for r in recs)  # arrays never hit JSONL
 
 

@@ -80,10 +80,14 @@ def tiny_pipeline():
 
 def standard_trace(n: int = 24, seed: int = 8, steps: int = 4,
                    fault_rate: float = 0.25, cancel_rate: float = 0.1,
-                   kinds=("transient", "poison", "nan")):
+                   kinds=("transient", "poison", "nan"),
+                   gate_mix=None):
     """(trace, FaultPlan) pair for the standard drill — all seeded, so
     every caller (CLI, quality gate, bench) drills the identical scenario
-    for the same arguments."""
+    for the same arguments. ``gate_mix`` (a ``loadgen.parse_gate_mix``
+    spec string) draws per-request phase gates, so the drill exercises the
+    two-pool hand-off path; the default keeps the historical all-ungated
+    trace byte-identical."""
     import importlib.util
 
     from p2p_tpu.serve.chaos import FaultPlan
@@ -93,8 +97,9 @@ def standard_trace(n: int = 24, seed: int = 8, steps: int = 4,
     loadgen = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(loadgen)
 
-    trace = loadgen.generate_trace(n, mode="poisson", rate_per_s=50.0,
-                                   seed=seed, steps=steps)
+    trace = loadgen.generate_trace(
+        n, mode="poisson", rate_per_s=50.0, seed=seed, steps=steps,
+        gate_mix=(loadgen.parse_gate_mix(gate_mix) if gate_mix else None))
     plan = FaultPlan.from_dict(
         loadgen.fault_plan_dict(trace, seed, fault_rate, kinds=kinds))
     if cancel_rate > 0:
@@ -164,11 +169,38 @@ def run_drill(pipe, trace, plan, *, watchdog_ms=None, journal_path=None,
     ``warmup=True`` runs the clean trace once unmeasured first, so the
     measured runs both hit warm compile caches and the reported p95 delta
     is retry/backoff cost, not compile noise."""
-    from p2p_tpu.serve import serve_forever
+    from p2p_tpu.serve import Request, prepare, serve_forever
 
+    # phase2_max_batch pinned to max_batch: the drill's bitwise invariant
+    # compares clean vs faulted runs whose batch *composition* may differ
+    # (wall-clock timing feeds the virtual clock). Padding within one
+    # bucket is proven bitwise-invariant; different buckets are only
+    # vmap-tolerance-equal — so the drill keeps every pool on one bucket.
     kw = dict(max_batch=4, max_wait_ms=20.0, queue_cap=256,
-              validate_outputs=True)
+              validate_outputs=True, phase2_max_batch=4)
     kw.update(serve_kw or {})
+
+    # Bucket-pinning compile-ahead (the PR-5-era "host-drift" resilience
+    # flake, root-caused): flush boundaries are host-load-dependent, so
+    # without prewarm a partial flush early in one run compiles (and
+    # rides) a SMALLER bucket than the same requests hit in the other run
+    # — and cross-bucket vmap widths only match to ±1, breaking the
+    # bitwise invariant under contention. Warming every distinct compile
+    # key at the max bucket makes warm-preference pad every dispatch
+    # (full, partial, isolation re-run) to that one bucket, so outputs
+    # are composition-independent — and it mirrors what the serve CLI
+    # does by default (compile-ahead).
+    if "prewarm" not in kw:
+        reps, seen = [], set()
+        for d in trace:
+            if "request_id" not in d:
+                continue
+            r = Request.from_dict(d)
+            key = prepare(r, pipe).compile_key
+            if key not in seen:
+                seen.add(key)
+                reps.append(r)
+        kw["prewarm"] = reps
 
     if warmup:
         for _ in serve_forever(pipe, list(trace), **kw):
@@ -204,6 +236,11 @@ def run_drill(pipe, trace, plan, *, watchdog_ms=None, journal_path=None,
         "p95_faulted_ms": faulted_summary["p95_ms"],
         "p95_delta_ms": faulted_summary["p95_ms"] - clean_summary["p95_ms"],
     }
+    if "phases" in faulted_summary:
+        # Gate-mixed traces drill the two-pool hand-off path: surface how
+        # much of the drill actually crossed it (a gated drill with zero
+        # hand-offs would be vacuous).
+        result["handoffs"] = faulted_summary["phases"]["handoffs"]
 
     if crash_after is not None:
         if journal_path is None:
@@ -272,6 +309,12 @@ def crash_replay_drill(pipe, trace, journal_path, crash_after: int,
         "already_terminal": len(replay.terminal),
         "skipped_corrupt": replay.skipped_corrupt,
         "replay": summary2.get("replay"),
+        # Requests the crash caught *between* their phases resume in
+        # phase 2 off the journaled hand-off spill (0 when the crash
+        # landed elsewhere; the deterministic mid-hand-off case is pinned
+        # by tests/test_handoff.py).
+        "resumed_handoffs": summary2.get("phases", {}).get(
+            "resumed_handoffs", 0),
     }
 
 

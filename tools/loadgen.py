@@ -16,7 +16,12 @@ Requests cycle through a small prompt corpus of 2-prompt replace edits
 sharing one compile key (seeds and prompts vary — traced values — so the
 whole trace rides one compiled program per bucket; that is the point of
 compile-key bucketing). ``--distinct-keys N`` spreads the trace over N
-step-counts instead, for cache-pressure experiments.
+step-counts instead, for cache-pressure experiments. ``--gate-mix`` draws
+each request's phase-gate spec from a weighted distribution (e.g.
+``0.5:2,off:1``) with the same seeded RNG, so a trace actually exercises
+the serve layer's phase hand-off and mixed-phase packing; the default
+(no mix, no ``--gate``) keeps every request ungated — byte-identical to
+pre-gate-mix traces.
 
     python tools/loadgen.py --n 48 --mode poisson --rate 20 --seed 0 \
         --steps 4 --out demo.jsonl
@@ -48,6 +53,35 @@ _CORPUS = (
 )
 
 
+def parse_gate_mix(spec: str) -> List[tuple]:
+    """``"0.5:2,off:1,auto:1"`` → ``[(0.5, 2.0), (None, 1.0), ('auto',
+    1.0)]`` — weighted gate specs, ``off``/``none`` meaning ungated, a
+    bare entry meaning weight 1. Weights must be positive."""
+    out: List[tuple] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            val, w_str = part.rsplit(":", 1)
+            weight = float(w_str)
+        else:
+            val, weight = part, 1.0
+        if weight <= 0:
+            raise ValueError(f"gate-mix weight must be positive in {part!r}")
+        val = val.strip()
+        if val in ("off", "none"):
+            gate = None
+        elif val == "auto":
+            gate = "auto"
+        else:
+            gate = float(val) if "." in val else int(val)
+        out.append((gate, weight))
+    if not out:
+        raise ValueError(f"empty gate mix {spec!r}")
+    return out
+
+
 def generate_trace(
     n: int,
     mode: str = "poisson",
@@ -60,9 +94,14 @@ def generate_trace(
     deadline_ms: Optional[float] = None,
     distinct_keys: int = 1,
     gate=None,
+    gate_mix: Optional[List[tuple]] = None,
 ) -> List[dict]:
     """Build ``n`` request dicts sorted by ``arrival_ms`` (deterministic in
-    ``seed``). See the module docstring for the two modes."""
+    ``seed``). See the module docstring for the two modes. ``gate_mix``
+    (:func:`parse_gate_mix` pairs) draws each request's gate from the
+    weighted distribution — it overrides ``gate``, and the draws ride a
+    separate seed-derived RNG stream, so arrivals and seeds stay
+    byte-identical to the no-mix trace."""
     import numpy as np
 
     if n < 1:
@@ -71,6 +110,13 @@ def generate_trace(
         raise ValueError(f"mode must be 'poisson' or 'burst', got {mode!r}")
     if rate_per_s <= 0:
         raise ValueError(f"rate_per_s must be positive, got {rate_per_s}")
+    if gate_mix is not None:
+        total_w = sum(w for _, w in gate_mix)
+        cuts = np.cumsum([w / total_w for _, w in gate_mix])
+        # A separate derived stream (the with_cancels idiom): gate draws
+        # must not perturb the arrival/seed stream, so a mixed trace stays
+        # byte-identical to the no-mix trace everywhere but 'gate'.
+        gate_rng = np.random.RandomState(seed ^ 0x6A7E)
     rng = np.random.RandomState(seed)
     if mode == "poisson":
         gaps = rng.exponential(1000.0 / rate_per_s, size=n)
@@ -92,8 +138,14 @@ def generate_trace(
             "seed": int(rng.randint(0, 2 ** 31 - 1)),
             "arrival_ms": round(float(at), 3),
         }
-        if gate is not None:
-            req["gate"] = gate
+        req_gate = gate
+        if gate_mix is not None:
+            draw = gate_rng.random_sample()
+            req_gate = gate_mix[int(np.searchsorted(cuts, draw,
+                                                    side="right"))
+                                if draw < cuts[-1] else len(gate_mix) - 1][0]
+        if req_gate is not None:
+            req["gate"] = req_gate
         if deadline_ms is not None:
             req["deadline_ms"] = deadline_ms
         out.append(req)
@@ -160,6 +212,13 @@ def main(argv=None) -> int:
     ap.add_argument("--gate", default=None,
                     help="phase-gate spec stamped on every request "
                          "('auto', a fraction, or a step index)")
+    ap.add_argument("--gate-mix", default=None, metavar="SPEC",
+                    help="weighted gate distribution drawn per request "
+                         "from the trace seed, e.g. '0.5:2,off:1,auto:1' "
+                         "(value ':' weight; 'off'/'none' = ungated; bare "
+                         "value = weight 1). Overrides --gate; exercises "
+                         "the serve layer's phase hand-off and "
+                         "mixed-phase packing")
     ap.add_argument("--cancel-rate", type=float, default=0.0,
                     help="interleave seeded {'cancel': id} markers at this "
                          "per-request probability (each victim cancelled "
@@ -182,12 +241,13 @@ def main(argv=None) -> int:
     gate = args.gate
     if isinstance(gate, str) and gate != "auto":
         gate = float(gate) if "." in gate else int(gate)
+    gate_mix = parse_gate_mix(args.gate_mix) if args.gate_mix else None
     trace = generate_trace(
         args.n, mode=args.mode, rate_per_s=args.rate, seed=args.seed,
         steps=args.steps, scheduler=args.scheduler,
         burst_size=args.burst_size, burst_gap_ms=args.burst_gap_ms,
         deadline_ms=args.deadline_ms, distinct_keys=args.distinct_keys,
-        gate=gate)
+        gate=gate, gate_mix=gate_mix)
     if args.fault_rate > 0:
         plan_path = args.fault_plan_out or (
             args.out and args.out + ".faults.json")

@@ -81,13 +81,18 @@ def _phase_gate_drift():
 
 
 def _serve_parity():
-    """max|Δ| between one golden replace edit served through the full
-    request path (queue → batcher → program cache → sweep) and the same
-    spec run directly through ``text2image`` — the serving layer's
+    """max|Δ| between golden edits served through the full request path
+    (queue → batcher → program cache → sweep) and the same specs run
+    directly through ``text2image`` — the serving layer's
     numerics-neutrality contract (ISSUE 2): batching, padding and program
     caching must be bitwise-invisible. The controller is built through the
     same shared factory (``cli.controller_from_opts``) on both sides, so
-    the only variable is the serving machinery itself."""
+    the only variable is the serving machinery itself.
+
+    Two legs: the ungated single-lane case (the historical contract), and
+    a GATED request that crosses the phase-disaggregated hand-off
+    (ISSUE 6) — phase-1 pool → carry → phase-2 pool must reproduce direct
+    gated ``text2image`` bitwise too."""
     import jax
 
     from p2p_tpu.cli import controller_from_opts
@@ -99,20 +104,25 @@ def _serve_parity():
     pipe = _pipe(TINY)
     steps, seed = 3, 42
     prompts = ["a squirrel eating a burger", "a squirrel eating a lasagna"]
-    req = Request(request_id="golden", prompt=prompts[0], target=prompts[1],
-                  mode="replace", steps=steps, seed=seed)
-    recs = [r for r in serve_forever(pipe, [req], max_batch=4,
-                                     max_wait_ms=1.0)
-            if r["status"] == "ok"]
-    assert len(recs) == 1, f"serve path produced {len(recs)} ok records"
     ctrl = controller_from_opts(prompts, pipe.tokenizer, steps,
                                 mode="replace", cross_steps=0.8,
                                 self_steps=0.4)
-    want, _, _ = text2image(pipe, prompts, ctrl, num_steps=steps,
-                            rng=jax.random.PRNGKey(seed))
-    d = np.abs(recs[0]["images"].astype(np.int16)
-               - np.asarray(want).astype(np.int16))
-    return int(d.max())
+    worst = 0
+    for name, gate in (("golden", None), ("golden-gated", 0.5)):
+        req = Request(request_id=name, prompt=prompts[0], target=prompts[1],
+                      mode="replace", steps=steps, seed=seed, gate=gate)
+        recs = [r for r in serve_forever(pipe, [req], max_batch=4,
+                                         max_wait_ms=1.0)
+                if r["status"] == "ok"]
+        assert len(recs) == 1, f"serve path produced {len(recs)} ok records"
+        if gate is not None:
+            assert "phases" in recs[0], "gated request skipped the pools"
+        want, _, _ = text2image(pipe, prompts, ctrl, num_steps=steps,
+                                rng=jax.random.PRNGKey(seed), gate=gate)
+        d = np.abs(recs[0]["images"].astype(np.int16)
+                   - np.asarray(want).astype(np.int16))
+        worst = max(worst, int(d.max()))
+    return worst
 
 
 def _fault_drill():
@@ -133,9 +143,18 @@ def _fault_drill():
     drill = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(drill)
 
+    pipe = drill.tiny_pipeline()
     trace, plan = drill.standard_trace()
-    return drill.run_drill(drill.tiny_pipeline(), trace, plan,
-                           crash_after=8, warmup=True)
+    res = drill.run_drill(pipe, trace, plan, crash_after=8, warmup=True)
+    # The gated leg (ISSUE 6): the same seeded drill over a gate-mix trace,
+    # so faults, cancellations and the crash-replay land on requests that
+    # cross the two-pool hand-off — exactly-once and bitwise-stable must
+    # hold through it (the deterministic mid-hand-off crash case itself is
+    # pinned by tests/test_handoff.py).
+    gtrace, gplan = drill.standard_trace(gate_mix="0.5:3,off:1")
+    res["gated"] = drill.run_drill(pipe, gtrace, gplan, crash_after=8,
+                                   warmup=True)
+    return res
 
 
 def _obs_overhead(reps=4):
@@ -327,14 +346,23 @@ def main(argv=None) -> int:
         else:
             fired = sum(res["faults"].values())
             replay = res["crash_replay"]
+            gated = res["gated"]
             ok = (res["bitwise_compared"] > 0 and fired > 0
                   and res["retries"] > 0 and replay["replayed_pending"] > 0
-                  and replay["skipped_corrupt"] == 0)
+                  and replay["skipped_corrupt"] == 0
+                  # The gated leg must actually cross the hand-off and
+                  # hold the same invariants (run_drill raised otherwise).
+                  and gated["bitwise_compared"] > 0
+                  and gated.get("handoffs", 0) > 0
+                  and gated["crash_replay"]["skipped_corrupt"] == 0)
             print(f"{'fault_drill':16s} {fired} faults fired, "
                   f"{res['retries']} retries, "
                   f"{res['bitwise_compared']} ok outputs bitwise-stable, "
                   f"replay {replay['replayed_pending']} pending/"
-                  f"{replay['already_terminal']} terminal "
+                  f"{replay['already_terminal']} terminal; gated leg "
+                  f"{gated.get('handoffs', 0)} hand-offs, "
+                  f"{gated['bitwise_compared']} bitwise, "
+                  f"{gated['crash_replay']['resumed_handoffs']} resumed "
                   f"{'ok' if ok else 'DRIFT'}")
             if not ok:
                 drifted.append("fault_drill")
