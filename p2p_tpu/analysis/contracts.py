@@ -25,6 +25,10 @@ contracts that hand-written review keeps re-checking:
   sweep inputs are caller-reused); a future PR that donates must update
   the declaration, and one that declares without the lowering actually
   aliasing (or vice versa) fails here.
+- ``trace-invisible`` — re-tracing every canonical program under a *live*
+  request-scoped flight tracer (``obs.flight``: open context, attached
+  spans) yields byte-identical jaxpr fingerprints: flipping flight
+  tracing on/off can never change a compiled program.
 
 Programs traced (:func:`canonical_programs`): text2image ungated + gated
 (phase 1/2), serve batch programs across every lane bucket (1/2/4/8, the
@@ -496,6 +500,54 @@ def check_phase2_footprint(programs: List[Program]) -> List[ContractResult]:
     return out
 
 
+def check_trace_invisible(pipe=None, buckets=(1,),
+                          programs_fn=None) -> List[ContractResult]:
+    """The flight-tracing half of the disabled-invisible discipline:
+    flipping request-scoped tracing on/off must leave every canonical
+    program fingerprint identical — a hard error otherwise.
+
+    Flight tracing (``obs.flight``) is host-side by design; the day
+    someone threads a tracer hook into a traced function, the retrace
+    under a live tracer (open context, attached spans — the exact
+    conditions the serve loop creates around every dispatch) diverges
+    from the quiescent fingerprint and this contract names the program.
+    ``programs_fn`` is an injection point for the verdict-flip proof in
+    tests/test_jaxcheck.py."""
+    import hashlib
+
+    from ..obs import flight as flight_mod
+    from ..obs import spans as spans_mod
+
+    if pipe is None:
+        pipe = tiny_pipeline()
+    fn = programs_fn or canonical_programs
+
+    def fingerprints() -> Dict[str, str]:
+        return {p.name: hashlib.sha256(str(p.jaxpr).encode()).hexdigest()
+                for p in fn(pipe, buckets=buckets, metrics=False)}
+
+    base = fingerprints()
+    tracer = flight_mod.FlightTracer()
+    tracer.admit("jaxcheck-probe", 0.0, gated=True)
+    tracer.segment("jaxcheck-probe", "run", 0.0, 1.0, pool="phase1")
+    with spans_mod.attach(traces=tracer.current_trace_id("jaxcheck-probe")):
+        live = fingerprints()
+    tracer.finish("jaxcheck-probe", "ok", 1.0)
+    out = []
+    for name in sorted(base):
+        if name not in live:
+            out.append(ContractResult(
+                "trace-invisible", name, False,
+                "program missing from the tracer-live sweep"))
+            continue
+        ok = base[name] == live[name]
+        detail = ("fingerprint identical with tracing on/off" if ok else
+                  f"fingerprint changed under a live flight tracer: "
+                  f"{base[name][:12]} != {live[name][:12]}")
+        out.append(ContractResult("trace-invisible", name, ok, detail))
+    return out
+
+
 def _donated_params(lowered_text: str) -> int:
     """Count donated parameters in a lowering's StableHLO text: XLA marks
     them ``jax.buffer_donor`` (or legacy ``tf.aliasing_output``)."""
@@ -559,4 +611,8 @@ def run_contracts(pipe=None, buckets=(1, 2, 4, 8)) -> List[ContractResult]:
     results += check_phase2_footprint(plain)
     results += check_pool_footprint(plain)
     results += check_donation(pipe)
+    # Flight tracing joins the disabled-invisible sweep at one bucket
+    # (the check retraces the canonical set twice; the program identity
+    # property is bucket-independent).
+    results += check_trace_invisible(pipe, buckets=buckets[:1])
     return results

@@ -466,6 +466,24 @@ def cmd_serve(args) -> int:
     # all covered by the exported artifacts.
     obs_metrics.registry().reset()
     obs_spans.clear()
+    ring = args.events_ring
+    if ring is None:
+        env_ring = os.environ.get("P2P_OBS_EVENTS_RING")
+        if env_ring:
+            try:
+                ring = int(env_ring)
+            except ValueError:
+                raise SystemExit(f"P2P_OBS_EVENTS_RING must be an integer, "
+                                 f"got {env_ring!r}")
+    if ring is not None:
+        if ring < 1:
+            raise SystemExit(f"--events-ring must be >= 1, got {ring}")
+        obs_spans.set_capacity(ring)
+    flight_tracer = None
+    if args.flight_out or args.trace_out or args.blackbox:
+        from .obs import flight as obs_flight
+
+        flight_tracer = obs_flight.FlightTracer(blackbox_dir=args.blackbox)
     pipe = _build_pipeline(args)
     stream = sys.stdin if args.requests == "-" else open(args.requests)
     items = []
@@ -537,13 +555,32 @@ def cmd_serve(args) -> int:
                     validate_outputs=args.validate_outputs,
                     degrade=degrade,
                     phase_pools=not args.single_pool,
-                    phase2_max_batch=args.phase2_max_batch):
+                    phase2_max_batch=args.phase2_max_batch,
+                    flight=flight_tracer):
                 emit(rec)
     finally:
         if journal is not None:
             journal.close()
         if out is not sys.stdout:
             out.close()
+        if flight_tracer is not None:
+            # Written in the finally so a fatal drain's records (and a
+            # partially-drained trace) still produce the artifacts.
+            from .obs import flight as obs_flight
+
+            if args.flight_out:
+                os.makedirs(os.path.dirname(args.flight_out) or ".",
+                            exist_ok=True)
+                with open(args.flight_out, "w") as f:
+                    obs_flight.write_flight_jsonl(f, flight_tracer.records)
+                print(f"wrote {args.flight_out}", file=sys.stderr)
+            if args.trace_out:
+                os.makedirs(os.path.dirname(args.trace_out) or ".",
+                            exist_ok=True)
+                with open(args.trace_out, "w") as f:
+                    json.dump(obs_flight.chrome_trace(flight_tracer), f)
+                    f.write("\n")
+                print(f"wrote {args.trace_out}", file=sys.stderr)
     if args.metrics_out or args.events_out:
         from .obs import device as obs_device
 
@@ -798,6 +835,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "(serve.prewarm / serve.batch / serve.isolate_retry "
                         "start/stop events, JSONL) here after the trace "
                         "drains")
+    s.add_argument("--events-ring", type=int, default=None, metavar="N",
+                   help="span ring-buffer capacity (default 4096, or the "
+                        "P2P_OBS_EVENTS_RING env var): two-pool serving "
+                        "roughly doubles event volume, and an overflowing "
+                        "ring silently evicts mid-trace — the --events-out "
+                        "meta line's dropped count says when to raise this")
+    s.add_argument("--flight-out", default=None, metavar="FILE",
+                   help="request-scoped flight tracing: write one JSONL "
+                        "flight record per terminal (ordered stage "
+                        "segments across both pools, hand-off links, "
+                        "attribution self-check) here after the trace "
+                        "drains (docs/OBSERVABILITY.md)")
+    s.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write a Chrome-trace/Perfetto JSON of the run "
+                        "(one track per program pool, one async flow per "
+                        "request, hand-off arrows) here after the trace "
+                        "drains — open in https://ui.perfetto.dev or "
+                        "chrome://tracing")
+    s.add_argument("--blackbox", default=None, metavar="DIR",
+                   help="arm the flight recorder: on a fatal drain or a "
+                        "watchdog kill, dump a post-mortem bundle (span "
+                        "ring tail, in-flight flight records, pool/queue "
+                        "snapshot) into a numbered subdirectory of DIR")
     s.add_argument("--journal", default=None, metavar="FILE",
                    help="crash-safe request journal (append-only JSONL WAL, "
                         "fsync'd at batch boundaries); restarting against "

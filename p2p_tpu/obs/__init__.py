@@ -1,14 +1,19 @@
 """Unified telemetry: metrics registry, span tracing, device instrumentation.
 
-Three dependency-free modules every other subsystem reports through (see
+Dependency-free modules every other subsystem reports through (see
 docs/OBSERVABILITY.md for the metric catalog and span taxonomy):
 
 - :mod:`.metrics` — process-global registry of counters, gauges and
   fixed-bucket histograms with labeled families, snapshot/reset semantics,
   Prometheus text exposition and JSONL export.
-- :mod:`.spans` — nested wall-clock spans in a bounded ring buffer,
-  mirrored into ``jax.profiler.TraceAnnotation`` so host spans line up
-  with device xplane traces.
+- :mod:`.spans` — nested wall-clock spans in a bounded (configurable)
+  ring buffer, mirrored into ``jax.profiler.TraceAnnotation`` so host
+  spans line up with device xplane traces; ``attach()`` stamps spans
+  with request identity.
+- :mod:`.flight` — request-scoped flight tracing for the serve engine:
+  per-request stage timelines across the two program pools (stitched
+  across crash-replay), a Chrome-trace/Perfetto export, and the blackbox
+  post-mortem recorder.
 - :mod:`.device` — the host half of the compiled-loop callback channel
   (``utils.progress.emit_step``/``emit_event``): per-phase step timing,
   compile-time recording, device ``memory_stats()`` gauges. Imported
@@ -22,8 +27,8 @@ identity tests), and everything here is host-side — enabling it changes
 wall-clock overhead only, never numerics.
 """
 
-from . import metrics, spans  # noqa: F401  (device is imported explicitly)
+from . import flight, metrics, spans  # noqa: F401  (device is explicit)
 from .metrics import registry  # noqa: F401
 from .spans import span  # noqa: F401
 
-__all__ = ["metrics", "spans", "registry", "span"]
+__all__ = ["flight", "metrics", "spans", "registry", "span"]

@@ -1,0 +1,420 @@
+"""Request-scoped flight tracing for the two-pool serve engine.
+
+PR 3's observability layer is process-global: the span ring and the metric
+registry aggregate *across* requests, so once a gated request spans two
+separately scheduled program pools (phase-disaggregated continuous
+batching), a spill-to-disk carry hand-off, retries, isolation re-runs and
+possibly a crash-replay in a different process, no single artifact can
+answer "where did request X's latency go". This module is that missing
+layer: every admitted request gets a **trace context** —
+
+    trace_id = "<request_id>#<attempt epoch>"
+
+created at admission (epoch 0) and propagated through queue wait, batcher
+residency, phase-1 dispatch, the carry spill, the phase-2 batcher and
+dispatch, transient retries/backoff, isolation re-runs, degradation
+actions, and the terminal record. The journal's ``handoff`` record carries
+the context (:meth:`FlightTracer.context`), so a request resumed in
+phase 2 *by a different process* after a crash gets a stitched timeline:
+epoch bumps to 1, the pre-crash phase-1 segments ride along tagged with
+their original epoch, and an explicit ``handoff_resumed`` causal link
+names the pre-crash trace id.
+
+Three artifacts come out of the tracer:
+
+- **Flight records** (:attr:`FlightTracer.records`, ``serve --flight-out``)
+  — one JSON object per *terminal*: the ordered stage segments
+  (``queue_wait`` / ``fault`` / ``backoff`` / ``compile`` / ``run`` /
+  ``handoff_wait`` / ``requeue_wait``, each with virtual-clock start +
+  duration and its pool), the causal events, and a self-check that the
+  segment attribution sums to the recorded total
+  (``attribution_ok``/``unattributed_ms``) — queue + compile + run +
+  backoff + hand-off-wait must account for every virtual millisecond of
+  an ``ok`` request's life.
+- **Chrome trace** (:func:`chrome_trace`, ``serve --trace-out``) — the
+  Perfetto/``chrome://tracing`` JSON view: one track per pool
+  (mono / phase1 / phase2), stage segments as complete events, one async
+  span per request from admission to terminal, and a flow arrow from each
+  phase-1 ``run`` to its phase-2 ``run`` — the two-pool packing behavior
+  is literally visible.
+- **Blackbox bundle** (:meth:`FlightTracer.blackbox`, ``serve --blackbox
+  DIR``) — the post-mortem flight recorder: on a fatal drain or a
+  watchdog kill the engine dumps the span ring tail, every in-flight
+  (unfinished) flight context, the finished records so far, and a
+  pool/queue snapshot into a numbered bundle directory.
+
+Everything is host-side and virtual-clock-driven: the tracer never touches
+a traced program (the ``trace-invisible`` jaxpr contract in
+``analysis.contracts`` pins this), never reads the wall clock itself
+(every timestamp is handed in by the engine), and with a deterministic
+runner/timer the flight records are **byte-identical across reruns** —
+including the crash-resumed stitched timeline. ``flight=None`` (the
+default everywhere) keeps the serve record stream byte-identical to a
+tracer-enabled run: flight records are a sidecar artifact, never a change
+to the per-request contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from . import metrics as metrics_mod
+from . import spans as spans_mod
+
+#: Stages whose durations are the latency *attribution* of a request: they
+#: tile [arrival, terminal] in virtual time, so their sum must equal the
+#: recorded total (the flight record's self-check).
+ATTRIBUTION_STAGES = ("queue_wait", "handoff_wait", "requeue_wait",
+                      "fault", "backoff", "compile", "run")
+
+
+def trace_id(request_id: str, epoch: int) -> str:
+    return f"{request_id}#{epoch}"
+
+
+class FlightTracer:
+    """Per-request flight recorder for one serve loop.
+
+    The engine owns the clock: every call takes virtual-time values, and
+    the tracer only stores and assembles — which is what makes records
+    deterministic. One tracer covers one ``serve_forever`` run; the CLI
+    builds one per serve invocation.
+    """
+
+    def __init__(self, blackbox_dir: Optional[str] = None):
+        self.records: List[dict] = []
+        self.blackbox_dir = blackbox_dir
+        self.blackbox_bundles: List[str] = []
+        self.loop_events: List[dict] = []
+        self._inflight: Dict[str, dict] = {}
+        self._bundle_seq = 0
+        self._m_records = metrics_mod.registry().counter(
+            "serve_flight_records_total",
+            "terminal flight records by status", labels=("status",))
+
+    # -- context lifecycle -------------------------------------------------
+
+    def admit(self, request_id: str, vnow: float, *,
+              arrival_ms: Optional[float] = None, gated: bool = False,
+              forced_gate: bool = False, replayed: bool = False) -> dict:
+        """Open a trace context at admission (epoch 0). ``arrival_ms`` is
+        the request's *trace* arrival — latency accounting starts there,
+        exactly like the queue's (time blocked behind a running batch
+        before the single-threaded loop admitted it is real queue wait);
+        it defaults to ``vnow``. ``replayed`` marks a WAL-pending request
+        re-queued by a restarted loop (its arrival restarts on the new
+        incarnation's clock)."""
+        arrival = vnow if arrival_ms is None else arrival_ms
+        ctx = {"trace_id": trace_id(request_id, 0),
+               "request_id": request_id, "epoch": 0,
+               "arrival_ms": arrival, "cursor_ms": arrival,
+               "gated": gated, "segments": [], "events": [], "links": []}
+        self._inflight[request_id] = ctx
+        self.event(request_id, "admitted", vnow,
+                   **({"forced_gate": True} if forced_gate else {}),
+                   **({"replayed": True} if replayed else {}))
+        return ctx
+
+    def resume(self, request_id: str, prior: Optional[dict],
+               vnow: float) -> dict:
+        """Open a stitched context for a crash-replayed request resuming in
+        phase 2 off its journaled carry: the attempt epoch bumps, the
+        pre-crash segments/events ride along under their original epoch,
+        and a ``handoff_resumed`` link names the pre-crash trace id."""
+        prior = prior if isinstance(prior, dict) else {}
+        prev_epoch = int(prior.get("epoch", 0))
+        epoch = prev_epoch + 1
+        ctx = {"trace_id": trace_id(request_id, epoch),
+               "request_id": request_id, "epoch": epoch,
+               "arrival_ms": vnow, "cursor_ms": vnow,
+               "gated": True, "resumed": True,
+               "segments": list(prior.get("segments", ())),
+               "events": list(prior.get("events", ())),
+               "links": [{"kind": "handoff_resumed",
+                          "from": prior.get("trace_id",
+                                            trace_id(request_id,
+                                                     prev_epoch))}]}
+        self._inflight[request_id] = ctx
+        self.event(request_id, "handoff_resumed", vnow)
+        return ctx
+
+    def current_trace_id(self, request_id: str) -> str:
+        ctx = self._inflight.get(request_id)
+        return ctx["trace_id"] if ctx else trace_id(request_id, 0)
+
+    def context(self, request_id: str) -> Optional[dict]:
+        """The serializable context the journal's ``handoff`` record
+        carries — everything a restarted process needs to stitch the
+        resumed timeline to this incarnation's segments."""
+        ctx = self._inflight.get(request_id)
+        if ctx is None:
+            return None
+        return {"trace_id": ctx["trace_id"], "epoch": ctx["epoch"],
+                "segments": list(ctx["segments"]),
+                "events": list(ctx["events"])}
+
+    # -- timeline building -------------------------------------------------
+
+    def _ctx(self, request_id: str) -> dict:
+        ctx = self._inflight.get(request_id)
+        if ctx is None:          # e.g. a rejected submission: minimal ctx
+            ctx = self.admit(request_id, 0.0)
+        return ctx
+
+    def segment(self, request_id: str, stage: str, start_ms: float,
+                dur_ms: float, *, pool: Optional[str] = None,
+                **attrs: Any) -> None:
+        """Record one stage segment and advance the attribution cursor to
+        its end (segments are contiguous by construction)."""
+        ctx = self._ctx(request_id)
+        seg = {"stage": stage, "start_ms": start_ms, "dur_ms": dur_ms,
+               "epoch": ctx["epoch"]}
+        if pool is not None:
+            seg["pool"] = pool
+        seg.update(attrs)
+        ctx["segments"].append(seg)
+        ctx["cursor_ms"] = start_ms + dur_ms
+
+    def wait(self, request_id: str, stage: str, until_ms: float, *,
+             pool: Optional[str] = None, **attrs: Any) -> None:
+        """A wait segment from the context's cursor (end of the previous
+        segment, or arrival) to ``until_ms`` — how queue waits, hand-off
+        waits and isolation re-queues are attributed without the call
+        sites tracking interval starts."""
+        ctx = self._ctx(request_id)
+        start = ctx["cursor_ms"]
+        self.segment(request_id, stage, start,
+                     max(0.0, until_ms - start), pool=pool, **attrs)
+
+    def event(self, request_id: str, kind: str, vnow: float,
+              **attrs: Any) -> None:
+        ctx = self._ctx(request_id)
+        ctx["events"].append({"kind": kind, "ts_ms": vnow,
+                              "epoch": ctx["epoch"], **attrs})
+
+    def loop_event(self, kind: str, vnow: float, **attrs: Any) -> None:
+        """Loop-level transitions with no single owning request
+        (degradation level changes, fatal faults) — surfaced in the
+        Chrome trace as instants and in every blackbox bundle."""
+        self.loop_events.append({"kind": kind, "ts_ms": vnow, **attrs})
+
+    # -- terminal ----------------------------------------------------------
+
+    def finish(self, request_id: str, status: str, vnow: float, *,
+               total_ms: Optional[float] = None,
+               reason: Optional[str] = None) -> dict:
+        """Close the context into a flight record (one per terminal).
+
+        The self-check: the final epoch's attribution segments must sum to
+        the recorded total — exact (to float tolerance) under the virtual
+        clock for served requests; non-ok terminals report the residual
+        without a verdict (an expired request legitimately has unattributed
+        wait)."""
+        ctx = self._inflight.pop(request_id, None)
+        if ctx is None:
+            ctx = {"trace_id": trace_id(request_id, 0),
+                   "request_id": request_id, "epoch": 0,
+                   "arrival_ms": vnow, "gated": False,
+                   "segments": [], "events": [], "links": []}
+        if total_ms is None:
+            total_ms = vnow - ctx["arrival_ms"]
+        attributed = sum(s["dur_ms"] for s in ctx["segments"]
+                         if s["epoch"] == ctx["epoch"]
+                         and s["stage"] in ATTRIBUTION_STAGES)
+        rec = {"trace_id": ctx["trace_id"],
+               "request_id": request_id,
+               "epoch": ctx["epoch"],
+               "status": status,
+               "gated": ctx["gated"],
+               "arrival_ms": ctx["arrival_ms"],
+               "terminal_ms": vnow,
+               "total_ms": total_ms,
+               "attributed_ms": attributed,
+               "unattributed_ms": total_ms - attributed,
+               "links": ctx["links"],
+               "segments": ctx["segments"],
+               "events": ctx["events"] + [{"kind": "terminal",
+                                           "ts_ms": vnow,
+                                           "epoch": ctx["epoch"],
+                                           "status": status}]}
+        if ctx.get("resumed"):
+            rec["resumed"] = True
+        if reason is not None:
+            rec["reason"] = reason
+        if status == "ok":
+            rec["attribution_ok"] = abs(rec["unattributed_ms"]) <= 1e-6
+        self.records.append(rec)
+        self._m_records.labels(status=status).inc()
+        return rec
+
+    def inflight(self) -> List[dict]:
+        """Snapshot of every open context (admission order) — what the
+        blackbox preserves for requests that never reached a terminal."""
+        return [dict(ctx) for ctx in self._inflight.values()]
+
+    # -- the flight recorder -----------------------------------------------
+
+    def blackbox(self, reason: str, state: Optional[dict] = None) -> \
+            Optional[str]:
+        """Dump a post-mortem bundle (no-op without ``blackbox_dir``):
+
+        - ``state.json``   — the dump reason, the engine's pool/queue
+          snapshot, and the loop-level event list
+        - ``events.jsonl`` — the span ring tail (meta line first, so a
+          truncated view is detectable)
+        - ``inflight.jsonl`` — one line per open flight context
+        - ``flights.jsonl``  — the flight records finished before the dump
+
+        Bundles are numbered (``000_watchdog_timeout/``...) so repeated
+        incidents in one run never clobber each other. Returns the bundle
+        path, or None when disabled."""
+        if not self.blackbox_dir:
+            return None
+        slug = "".join(c if c.isalnum() else "_" for c in reason[:40])
+        bundle = os.path.join(self.blackbox_dir,
+                              f"{self._bundle_seq:03d}_{slug}")
+        self._bundle_seq += 1
+        os.makedirs(bundle, exist_ok=True)
+        with open(os.path.join(bundle, "state.json"), "w") as f:
+            json.dump({"reason": reason, "state": state or {},
+                       "loop_events": self.loop_events}, f, indent=1)
+            f.write("\n")
+        with open(os.path.join(bundle, "events.jsonl"), "w") as f:
+            spans_mod.write_jsonl(f)
+        with open(os.path.join(bundle, "inflight.jsonl"), "w") as f:
+            for ctx in self.inflight():
+                f.write(json.dumps(ctx) + "\n")
+        with open(os.path.join(bundle, "flights.jsonl"), "w") as f:
+            for rec in self.records:
+                f.write(json.dumps(rec) + "\n")
+        self.blackbox_bundles.append(bundle)
+        return bundle
+
+
+def write_flight_jsonl(fp, records: List[dict]) -> int:
+    """One JSON line per flight record; returns lines written."""
+    n = 0
+    for rec in records:
+        fp.write(json.dumps(rec) + "\n")
+        n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace / Perfetto export
+# ---------------------------------------------------------------------------
+
+_POOL_TIDS = {"mono": 1, "phase1": 2, "phase2": 3}
+_PID = 1
+
+
+def chrome_trace(tracer_or_records, loop_events: Optional[List[dict]] = None
+                 ) -> dict:
+    """Render flight records as a Chrome-trace JSON object (the
+    ``chrome://tracing`` / Perfetto ``trace.json`` format; timestamps are
+    the virtual clock in microseconds):
+
+    - one **track (thread) per pool** — ``mono``, ``phase1``, ``phase2`` —
+      carrying every stage segment as a complete (``X``) event, so the
+      two pools' packing is visible side by side;
+    - one **async span per request** (``b``/``e`` with ``id=trace_id``)
+      from arrival to terminal on its own async track;
+    - a **flow arrow** (``s``→``f``) from each phase-1 ``run`` segment to
+      the same request's phase-2 ``run``, crossing the hand-off;
+    - loop-level events (degradation, fatal) as instant (``i``) events.
+
+    A crash-stitched record's earlier-epoch segments carry the *previous
+    process's* virtual clock; they are rebased to end exactly at the
+    resumed incarnation's arrival, so the pre-crash phase-1 work renders
+    immediately before the resume (inside the request's async span) and
+    the hand-off flow arrow always points forward in time. If the rebase
+    reaches below zero, the whole trace is shifted up uniformly —
+    relative layout is the contract, the virtual epoch origin is not.
+    """
+    if isinstance(tracer_or_records, FlightTracer):
+        records = tracer_or_records.records
+        loop_events = (tracer_or_records.loop_events
+                       if loop_events is None else loop_events)
+    else:
+        records = list(tracer_or_records)
+    events: List[dict] = [
+        {"ph": "M", "pid": _PID, "tid": 0, "name": "process_name",
+         "args": {"name": "p2p-tpu serve (virtual clock)"}},
+    ]
+    for pool, tid in sorted(_POOL_TIDS.items(), key=lambda kv: kv[1]):
+        events.append({"ph": "M", "pid": _PID, "tid": tid,
+                       "name": "thread_name", "args": {"name": f"pool:{pool}"}})
+
+    def us(ms: float) -> float:
+        return round(ms * 1000.0, 3)
+
+    for rec in records:
+        tid_async = rec["trace_id"]
+        # Rebase earlier-epoch (pre-crash) segments: they were stamped on
+        # the previous process's virtual clock, so slide them to end at
+        # this incarnation's arrival — causally just before the resume.
+        prior = [s for s in rec["segments"] if s["epoch"] < rec["epoch"]]
+        rebase = 0.0
+        if prior:
+            rebase = rec["arrival_ms"] - max(
+                s["start_ms"] + s["dur_ms"] for s in prior)
+
+        def seg_start(seg, rebase=rebase, epoch=rec["epoch"]):
+            return seg["start_ms"] + (rebase if seg["epoch"] < epoch
+                                      else 0.0)
+
+        begin_ms = rec["arrival_ms"]
+        if prior:
+            begin_ms = min(begin_ms, min(seg_start(s) for s in prior))
+        events.append({"ph": "b", "cat": "request", "id": tid_async,
+                       "pid": _PID, "tid": 0, "name": rec["request_id"],
+                       "ts": us(begin_ms),
+                       "args": {"status": rec["status"],
+                                "gated": rec["gated"]}})
+        flow_end: Optional[float] = None
+        for seg in rec["segments"]:
+            pool = seg.get("pool", "mono")
+            start = seg_start(seg)
+            ev = {"ph": "X", "cat": seg["stage"],
+                  "name": seg["stage"], "pid": _PID,
+                  "tid": _POOL_TIDS.get(pool, 1),
+                  "ts": us(start), "dur": us(seg["dur_ms"]),
+                  "args": {"trace_id": rec["trace_id"],
+                           "epoch": seg["epoch"]}}
+            events.append(ev)
+            if seg["stage"] == "run":
+                if pool == "phase1":
+                    flow_end = start + seg["dur_ms"]
+                elif pool == "phase2" and flow_end is not None:
+                    fid = rec["trace_id"] + "/handoff"
+                    events.append({
+                        "ph": "s", "cat": "handoff", "id": fid,
+                        "name": "handoff", "pid": _PID,
+                        "tid": _POOL_TIDS["phase1"],
+                        "ts": us(min(flow_end, start))})
+                    events.append({
+                        "ph": "f", "cat": "handoff", "id": fid,
+                        "name": "handoff", "bp": "e", "pid": _PID,
+                        "tid": _POOL_TIDS["phase2"],
+                        "ts": us(start)})
+                    flow_end = None
+        events.append({"ph": "e", "cat": "request", "id": tid_async,
+                       "pid": _PID, "tid": 0, "name": rec["request_id"],
+                       "ts": us(rec["terminal_ms"])})
+    for ev in (loop_events or ()):
+        events.append({"ph": "i", "cat": "loop", "s": "g",
+                       "name": ev["kind"], "pid": _PID, "tid": 0,
+                       "ts": us(ev["ts_ms"]),
+                       "args": {k: v for k, v in ev.items()
+                                if k not in ("kind", "ts_ms")}})
+    # The rebase can reach below the epoch origin (a pre-crash history
+    # longer than the resumed arrival offset): shift the whole trace up
+    # uniformly so every timestamp is non-negative.
+    min_ts = min((e["ts"] for e in events if "ts" in e), default=0.0)
+    if min_ts < 0:
+        for e in events:
+            if "ts" in e:
+                e["ts"] = round(e["ts"] - min_ts, 3)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -50,8 +50,21 @@ class SpanRecorder:
         self.total = 0
 
     @property
+    def capacity(self) -> int:
+        return self._ring.maxlen
+
+    @property
     def dropped(self) -> int:
         return self.total - len(self._ring)
+
+    def resize(self, capacity: int) -> None:
+        """Change the ring capacity in place, keeping the most recent
+        events. ``total`` is preserved, so the ``dropped`` count stays
+        honest across a resize: shrinking evicts (and counts) the oldest
+        events exactly as organic eviction would."""
+        if capacity < 1:
+            raise ValueError(f"ring capacity must be >= 1, got {capacity}")
+        self._ring = deque(self._ring, maxlen=capacity)
 
     def emit(self, event: dict) -> None:
         self._ring.append(event)
@@ -67,6 +80,7 @@ class SpanRecorder:
 
 _recorder = SpanRecorder()
 _stack: List[int] = []           # active span ids, innermost last
+_attached: List[dict] = []       # attach() contexts, innermost last
 _ids = itertools.count(1)
 _enabled = True
 
@@ -74,6 +88,40 @@ _enabled = True
 def set_enabled(flag: bool) -> None:
     global _enabled
     _enabled = bool(flag)
+
+
+def set_capacity(capacity: int) -> None:
+    """Resize the process ring (``serve --events-ring`` /
+    ``P2P_OBS_EVENTS_RING``). Two-pool serving roughly doubles event
+    volume over the single-pool engine, and a too-small ring silently
+    evicts mid-trace — the meta line's ``dropped`` count stays honest
+    across any resize (see :meth:`SpanRecorder.resize`)."""
+    _recorder.resize(capacity)
+
+
+def capacity() -> int:
+    return _recorder.capacity
+
+
+@contextlib.contextmanager
+def attach(**attrs):
+    """Attach context attributes (request identity, trace ids) to every
+    span opened inside the block — how the flight-tracing layer stamps
+    dispatch spans with the requests they carry without every call site
+    threading ids by hand. Nested attaches merge, innermost winning; the
+    attributes ride both the start and end events."""
+    _attached.append(attrs)
+    try:
+        yield
+    finally:
+        _attached.pop()
+
+
+def _attached_attrs() -> dict:
+    out: dict = {}
+    for d in _attached:
+        out.update(d)
+    return out
 
 
 def recorder() -> SpanRecorder:
@@ -111,6 +159,8 @@ def span(name: str, **attrs):
     sid = next(_ids)
     parent = _stack[-1] if _stack else None
     depth = len(_stack)
+    if _attached:
+        attrs = {**_attached_attrs(), **attrs}
     t0 = time.perf_counter()
     _recorder.emit({"event": "span_start", "span": sid, "name": name,
                     "parent": parent, "depth": depth, "ts_ms": _now_ms(),

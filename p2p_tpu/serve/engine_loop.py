@@ -95,15 +95,32 @@ surface (``p2p-tpu serve --metrics-out/--events-out``), while the record
 stream above stays the stable per-request contract; the summary's p50/p95
 (raw lists) and the registry histograms must agree within one bucket
 (tests/test_obs.py pins this reconciliation).
+
+``flight=`` (an :class:`~p2p_tpu.obs.flight.FlightTracer`) adds
+*request-scoped* tracing on top: every admitted request gets a trace
+context (``request_id#epoch``) whose stage segments — queue wait, per-pool
+compile/run, transient fault + backoff, hand-off wait, isolation re-queue —
+tile its whole virtual-clock lifetime, closed into one flight record per
+terminal. The context rides the journal's ``handoff`` record, so a
+crash-replayed request resumed in phase 2 stitches its timeline to the
+pre-crash phase-1 segments (``handoff_resumed`` link); on a fatal drain or
+a watchdog kill the tracer's blackbox dumps the span-ring tail, the
+in-flight contexts and a pool/queue snapshot as a post-mortem bundle.
+``flight=None`` (default) is byte-invisible: the record stream, the
+journal bytes and the compiled programs are identical with the tracer off
+(tests/test_flight.py pins the parity; the ``trace-invisible`` jaxpr
+contract pins the program half).
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from typing import Callable, Iterable, Iterator, List, Optional
 
 from ..obs import metrics as obs_metrics
+from ..obs import spans as obs_spans
 from ..obs.spans import span
 from . import faults as faults_mod
 from . import handoff as handoff_mod
@@ -254,6 +271,7 @@ def serve_forever(
     degrade: Optional[DegradeConfig] = None,
     phase_pools: bool = True,
     phase2_max_batch: Optional[int] = None,
+    flight=None,
 ) -> Iterator[dict]:
     """Drain ``requests`` (Request/Cancel objects or JSONL-shaped dicts,
     sorted by ``arrival_ms``) through the queue → batcher → program-cache →
@@ -283,6 +301,12 @@ def serve_forever(
     caps the phase-2 pool's lane bucket (default: one fixed bucket above
     ``max_batch`` — same peak U-Net footprint, since phase-2 lanes carry
     no CFG uncond half).
+
+    ``flight`` (an ``obs.flight.FlightTracer``, default None = off) enables
+    request-scoped flight tracing: per-request stage timelines, the
+    Chrome-trace export and the blackbox post-mortem (see the module
+    docstring). Tracing is a pure sidecar — it never changes a record, a
+    journal byte, or a compiled program.
     """
     from ..engine.sampler import lane_select
     from ..utils import progress as progress_mod
@@ -438,9 +462,40 @@ def serve_forever(
         if journal is not None and journal_write:
             journal.terminal(request_id, status, vnow)
             journal.discard_carry(request_id)
+        if flight is not None and not (status == "rejected"
+                                       and not journal_write):
+            # One flight record per terminal. The duplicate-id rejection
+            # (journal_write=False) is skipped for the same reason its WAL
+            # line is: the id belongs to a still-live earlier request whose
+            # open trace context must survive.
+            flight.finish(request_id, status, vnow,
+                          total_ms=fields.get("total_ms"),
+                          reason=fields.get("reason"))
         if release:
             queue.release(request_id)
         return {"request_id": request_id, "status": status, **fields}
+
+    def _trace_attach(entries):
+        """Stamp the spans of a dispatch with the trace ids it carries
+        (``obs.spans.attach``) — a no-op context when tracing is off, so
+        the span event stream stays byte-stable for flight=None."""
+        if flight is None:
+            return contextlib.nullcontext()
+        return obs_spans.attach(traces=",".join(
+            flight.current_trace_id(e.request_id) for e in entries))
+
+    def _loop_state():
+        """Pool/queue snapshot for the blackbox bundle."""
+        return {"vnow_ms": round(vnow, 3),
+                "queue_waiting": len(queue),
+                "outstanding": queue.outstanding,
+                "batcher_waiting": {"main": len(batcher),
+                                    "phase2": len(batcher2)},
+                "degrade_level": degrade_level,
+                "batches_dispatched": batch_index,
+                "handoffs": handoffs_total,
+                "counts": dict(counts),
+                "program_cache": cache.stats()}
 
     def _build(factory, compile_key, bucket, entries):
         runner = factory(compile_key, bucket)
@@ -504,10 +559,21 @@ def serve_forever(
                                 m_resumed.inc()
                                 replayed_ids.add(rid)
                                 m_replay.labels(kind="handoff_resumed").inc()
+                                if flight is not None:
+                                    # Stitch this incarnation's timeline to
+                                    # the pre-crash phase-1 segments the WAL
+                                    # hand-off carried.
+                                    flight.resume(rid, ho.get("trace"), 0.0)
                                 continue
                         queue.submit(prep, 0.0)
                         replayed_ids.add(rid)
                         m_replay.labels(kind="pending").inc()
+                        if flight is not None:
+                            flight.admit(rid, 0.0,
+                                         gated=prep.gated and phase_pools,
+                                         replayed=True)
+                            if ho is not None:
+                                flight.event(rid, "handoff_lost", 0.0)
                     except (Rejected, ValueError) as e:
                         rid = d.get("request_id", "?")
                         m_rejects.labels(
@@ -618,20 +684,33 @@ def serve_forever(
         """Classify one dispatch failure and do the bookkeeping half of
         the verdict (taxonomy counters); returns ``(kind, reason)``.
         Shared by the primary dispatch and the isolation re-run so the
-        two paths cannot drift."""
+        two paths cannot drift. A FATAL verdict is the flight-recorder
+        moment: the blackbox dumps here, at impact, while every doomed
+        request's flight context is still open — the drain that follows
+        resolves them all."""
         kind = faults_mod.classify(exc)
         fault_counts[kind] += 1
         m_faults.labels(kind=kind).inc()
-        return kind, f"{type(exc).__name__}: {exc}"
+        reason = f"{type(exc).__name__}: {exc}"
+        if kind == faults_mod.FATAL and flight is not None:
+            flight.loop_event("fatal", vnow, reason=reason)
+            flight.blackbox("fatal_fault", _loop_state())
+        return kind, reason
 
     def _note_timeout(compile_key, bucket):
         """Watchdog-timeout bookkeeping: the program handle is suspect, so
         quarantine it; the next miss rebuilds instead of reusing a
-        possibly-wedged executable. Shared by both dispatch paths."""
+        possibly-wedged executable. Shared by both dispatch paths. A
+        watchdog kill is a flight-recorder moment: the blackbox bundle is
+        dumped *before* the victims' terminal records, so their still-open
+        contexts land in ``inflight.jsonl``."""
         nonlocal timeouts_total
         timeouts_total += 1
         m_timeouts.inc()
         cache.quarantine((compile_key, bucket))
+        if flight is not None:
+            flight.loop_event("watchdog_timeout", vnow)
+            flight.blackbox("watchdog_timeout", _loop_state())
 
     def _live_after_backoff(entries):
         """Split entries into (records to yield, survivors) after vnow
@@ -688,6 +767,10 @@ def serve_forever(
             journal.dispatched([e.request_id for e in live], this_batch,
                                vnow)
         dispatch_ms = vnow
+        if flight is not None:
+            for e in live:
+                flight.wait(e.request_id, "queue_wait", dispatch_ms,
+                            pool="mono")
         attempt = 0
         while True:
             fault = (chaos.take(this_batch, [e.request_id for e in live])
@@ -695,17 +778,24 @@ def serve_forever(
             t0 = timer()
             try:
                 span_name = "serve.batch" if attempt == 0 else "serve.retry"
-                with span(span_name, batch=this_batch, lanes=bucket,
-                          occupancy=len(live),
-                          **({"attempt": attempt} if attempt else {})):
+                with _trace_attach(live), \
+                        span(span_name, batch=this_batch, lanes=bucket,
+                             occupancy=len(live),
+                             **({"attempt": attempt} if attempt else {})):
                     imgs, run_ms, hit, steps_done, finite = run_entries(
                         live, compile_key, guidance, bucket, fault=fault)
                 total_ms = (timer() - t0) * 1000.0
                 compile_ms = max(0.0, total_ms - run_ms)
                 break
             except Exception as exc:  # noqa: BLE001 — classified below
-                vnow += (timer() - t0) * 1000.0
+                elapsed = (timer() - t0) * 1000.0
+                vnow += elapsed
                 kind, reason = _fault_verdict(exc)
+                if flight is not None:
+                    for e in live:
+                        flight.segment(e.request_id, "fault",
+                                       vnow - elapsed, elapsed, pool="mono",
+                                       kind=kind, attempt=attempt)
                 if kind == faults_mod.TIMEOUT:
                     # A hung compile/execute: terminal records instead of a
                     # wedged server.
@@ -731,6 +821,11 @@ def serve_forever(
                         m_retries.inc()
                         m_backoff.observe(backoff)
                         vnow += backoff
+                        if flight is not None:
+                            for e in live:
+                                flight.segment(e.request_id, "backoff",
+                                               vnow - backoff, backoff,
+                                               pool="mono", attempt=attempt)
                         attempt += 1
                         # The backoff budget is each lane's deadline:
                         # entries it outspent expire now instead of
@@ -751,7 +846,14 @@ def serve_forever(
                 # poison: the pre-existing lane-isolation path.
                 yield from isolate(live, compile_key, guidance, exc)
                 return
+        v0 = vnow
         vnow += compile_ms + run_ms
+        if flight is not None:
+            for e in live:
+                flight.segment(e.request_id, "compile", v0, compile_ms,
+                               pool="mono", cache_hit=hit)
+                flight.segment(e.request_id, "run", v0 + compile_ms, run_ms,
+                               pool="mono", batch_id=this_batch)
         occupancies.append(len(live))
         # Observed only on success, next to the summary's list, so the
         # histogram and mean_batch_occupancy reconcile exactly (a poisoned
@@ -806,18 +908,30 @@ def serve_forever(
             if journal is not None:
                 journal.dispatched([e.request_id], batch_index, vnow)
             dispatch_ms = vnow
+            if flight is not None:
+                # The time between the poisoned batch's failure and this
+                # lane's solo dispatch (earlier lanes' re-runs) is real
+                # latency the flight record must attribute.
+                flight.wait(e.request_id, "requeue_wait", dispatch_ms,
+                            pool="mono", isolated=True)
             fault = (chaos.take(batch_index, [e.request_id])
                      if chaos is not None else None)
             try:
                 t0 = timer()
-                with span("serve.isolate_retry", batch=batch_index,
-                          lanes=bucket, request=e.request_id):
+                with _trace_attach([e]), \
+                        span("serve.isolate_retry", batch=batch_index,
+                             lanes=bucket, request=e.request_id):
                     imgs, run_ms, hit, steps_done, finite = run_entries(
                         [e], compile_key, guidance, bucket, fault=fault)
                 compile_ms = max(0.0, (timer() - t0) * 1000.0 - run_ms)
             except Exception as exc:  # noqa: BLE001 — classified below
-                vnow += (timer() - t0) * 1000.0
+                elapsed = (timer() - t0) * 1000.0
+                vnow += elapsed
                 kind, reason = _fault_verdict(exc)
+                if flight is not None:
+                    flight.segment(e.request_id, "fault", vnow - elapsed,
+                                   elapsed, pool="mono", kind=kind,
+                                   isolated=True)
                 batch_err = f"{type(batch_exc).__name__}: {batch_exc}"
                 if kind == faults_mod.TIMEOUT:
                     # Same verdict as a hung primary dispatch.
@@ -842,7 +956,14 @@ def serve_forever(
                     "error", e.request_id, arrival_ms=e.arrival_ms,
                     reason=reason, batch_error=batch_err)
                 continue
+            v0 = vnow
             vnow += compile_ms + run_ms
+            if flight is not None:
+                flight.segment(e.request_id, "compile", v0, compile_ms,
+                               pool="mono", cache_hit=hit, isolated=True)
+                flight.segment(e.request_id, "run", v0 + compile_ms, run_ms,
+                               pool="mono", batch_id=batch_index,
+                               isolated=True)
             occupancies.append(1)
             # success-only, mirroring dispatch()
             m_occupancy.labels(phase="mono").observe(1.0)
@@ -899,10 +1020,17 @@ def serve_forever(
                   "cache_hit": hit}
             if isolated:
                 p1["isolated_retry"] = True
+            if flight is not None:
+                flight.event(e.request_id, "handoff", vnow,
+                             batch_id=batch_id)
             if journal is not None:
                 path = journal.carry_path(e.request_id)
                 spec = handoff_mod.spill_carry(c, path)
-                journal.handoff(e.request_id, vnow, path, spec)
+                if flight is not None:
+                    flight.event(e.request_id, "carry_spilled", vnow)
+                journal.handoff(e.request_id, vnow, path, spec,
+                                trace=(flight.context(e.request_id)
+                                       if flight is not None else None))
             handoffs_total += 1
             m_handoffs.inc()
             batcher2.add(HandoffEntry(entry=e, carry=c, handoff_ms=vnow,
@@ -940,6 +1068,10 @@ def serve_forever(
             journal.dispatched([e.request_id for e in live], this_batch,
                                vnow, phase=1)
         dispatch_ms = vnow
+        if flight is not None:
+            for e in live:
+                flight.wait(e.request_id, "queue_wait", dispatch_ms,
+                            pool="phase1")
         attempt = 0
         while True:
             fault = (chaos.take(this_batch, [e.request_id for e in live])
@@ -947,17 +1079,25 @@ def serve_forever(
             t0 = timer()
             try:
                 span_name = "serve.batch" if attempt == 0 else "serve.retry"
-                with span(span_name, batch=this_batch, lanes=bucket,
-                          occupancy=len(live), phase=1,
-                          **({"attempt": attempt} if attempt else {})):
+                with _trace_attach(live), \
+                        span(span_name, batch=this_batch, lanes=bucket,
+                             occupancy=len(live), phase=1,
+                             **({"attempt": attempt} if attempt else {})):
                     carry_g, run_ms, hit, _, _ = run_entries(
                         live, compile_key, guidance, bucket, fault=fault)
                 total_ms = (timer() - t0) * 1000.0
                 compile_ms = max(0.0, total_ms - run_ms)
                 break
             except Exception as exc:  # noqa: BLE001 — classified below
-                vnow += (timer() - t0) * 1000.0
+                elapsed = (timer() - t0) * 1000.0
+                vnow += elapsed
                 kind, reason = _fault_verdict(exc)
+                if flight is not None:
+                    for e in live:
+                        flight.segment(e.request_id, "fault",
+                                       vnow - elapsed, elapsed,
+                                       pool="phase1", kind=kind,
+                                       attempt=attempt)
                 if kind == faults_mod.TIMEOUT:
                     _note_timeout(compile_key, bucket)
                     for e in live:
@@ -981,6 +1121,12 @@ def serve_forever(
                         m_retries.inc()
                         m_backoff.observe(backoff)
                         vnow += backoff
+                        if flight is not None:
+                            for e in live:
+                                flight.segment(e.request_id, "backoff",
+                                               vnow - backoff, backoff,
+                                               pool="phase1",
+                                               attempt=attempt)
                         attempt += 1
                         recs, live = _live_after_backoff(live)
                         yield from recs
@@ -997,7 +1143,14 @@ def serve_forever(
                     return
                 yield from isolate_phase1(live, compile_key, guidance, exc)
                 return
+        v0 = vnow
         vnow += compile_ms + run_ms
+        if flight is not None:
+            for e in live:
+                flight.segment(e.request_id, "compile", v0, compile_ms,
+                               pool="phase1", cache_hit=hit)
+                flight.segment(e.request_id, "run", v0 + compile_ms, run_ms,
+                               pool="phase1", batch_id=this_batch)
         occupancies.append(len(live))
         occ_by_phase["phase1"].append(len(live))
         m_occupancy.labels(phase="phase1").observe(float(len(live)))
@@ -1019,18 +1172,27 @@ def serve_forever(
                 journal.dispatched([e.request_id], batch_index, vnow,
                                    phase=1)
             dispatch_ms = vnow
+            if flight is not None:
+                flight.wait(e.request_id, "requeue_wait", dispatch_ms,
+                            pool="phase1", isolated=True)
             fault = (chaos.take(batch_index, [e.request_id])
                      if chaos is not None else None)
             try:
                 t0 = timer()
-                with span("serve.isolate_retry", batch=batch_index,
-                          lanes=bucket, request=e.request_id, phase=1):
+                with _trace_attach([e]), \
+                        span("serve.isolate_retry", batch=batch_index,
+                             lanes=bucket, request=e.request_id, phase=1):
                     carry_g, run_ms, hit, _, _ = run_entries(
                         [e], compile_key, guidance, bucket, fault=fault)
                 compile_ms = max(0.0, (timer() - t0) * 1000.0 - run_ms)
             except Exception as exc:  # noqa: BLE001 — classified below
-                vnow += (timer() - t0) * 1000.0
+                elapsed = (timer() - t0) * 1000.0
+                vnow += elapsed
                 kind, reason = _fault_verdict(exc)
+                if flight is not None:
+                    flight.segment(e.request_id, "fault", vnow - elapsed,
+                                   elapsed, pool="phase1", kind=kind,
+                                   isolated=True)
                 batch_err = f"{type(batch_exc).__name__}: {batch_exc}"
                 if kind == faults_mod.TIMEOUT:
                     _note_timeout(compile_key, bucket)
@@ -1051,7 +1213,14 @@ def serve_forever(
                     "error", e.request_id, arrival_ms=e.arrival_ms,
                     reason=reason, batch_error=batch_err)
                 continue
+            v0 = vnow
             vnow += compile_ms + run_ms
+            if flight is not None:
+                flight.segment(e.request_id, "compile", v0, compile_ms,
+                               pool="phase1", cache_hit=hit, isolated=True)
+                flight.segment(e.request_id, "run", v0 + compile_ms,
+                               run_ms, pool="phase1", batch_id=batch_index,
+                               isolated=True)
             occupancies.append(1)
             occ_by_phase["phase1"].append(1)
             m_occupancy.labels(phase="phase1").observe(1.0)
@@ -1134,6 +1303,12 @@ def serve_forever(
             journal.dispatched([e.request_id for e in live], this_batch,
                                vnow, phase=2)
         dispatch_ms = vnow
+        if flight is not None:
+            for e in live:
+                # Cursor sits at the end of the phase-1 run (or at 0 for a
+                # crash-resumed lane): the wait is hand-off → dispatch.
+                flight.wait(e.request_id, "handoff_wait", dispatch_ms,
+                            pool="phase2")
         attempt = 0
         while True:
             fault = (chaos.take(this_batch, [e.request_id for e in live])
@@ -1141,17 +1316,25 @@ def serve_forever(
             t0 = timer()
             try:
                 span_name = "serve.batch" if attempt == 0 else "serve.retry"
-                with span(span_name, batch=this_batch, lanes=bucket,
-                          occupancy=len(live), phase=2,
-                          **({"attempt": attempt} if attempt else {})):
+                with _trace_attach(live), \
+                        span(span_name, batch=this_batch, lanes=bucket,
+                             occupancy=len(live), phase=2,
+                             **({"attempt": attempt} if attempt else {})):
                     imgs, run_ms, hit, _, finite = run_entries(
                         live, compile_key, guidance, bucket, fault=fault)
                 total_ms = (timer() - t0) * 1000.0
                 compile_ms = max(0.0, total_ms - run_ms)
                 break
             except Exception as exc:  # noqa: BLE001 — classified below
-                vnow += (timer() - t0) * 1000.0
+                elapsed = (timer() - t0) * 1000.0
+                vnow += elapsed
                 kind, reason = _fault_verdict(exc)
+                if flight is not None:
+                    for e in live:
+                        flight.segment(e.request_id, "fault",
+                                       vnow - elapsed, elapsed,
+                                       pool="phase2", kind=kind,
+                                       attempt=attempt)
                 if kind == faults_mod.TIMEOUT:
                     _note_timeout(compile_key, bucket)
                     for e in live:
@@ -1175,6 +1358,12 @@ def serve_forever(
                         m_retries.inc()
                         m_backoff.observe(backoff)
                         vnow += backoff
+                        if flight is not None:
+                            for e in live:
+                                flight.segment(e.request_id, "backoff",
+                                               vnow - backoff, backoff,
+                                               pool="phase2",
+                                               attempt=attempt)
                         attempt += 1
                         recs, live = _live_after_backoff(live)
                         yield from recs
@@ -1191,7 +1380,14 @@ def serve_forever(
                     return
                 yield from isolate_phase2(live, compile_key, guidance, exc)
                 return
+        v0 = vnow
         vnow += compile_ms + run_ms
+        if flight is not None:
+            for e in live:
+                flight.segment(e.request_id, "compile", v0, compile_ms,
+                               pool="phase2", cache_hit=hit)
+                flight.segment(e.request_id, "run", v0 + compile_ms, run_ms,
+                               pool="phase2", batch_id=this_batch)
         occupancies.append(len(live))
         occ_by_phase["phase2"].append(len(live))
         m_occupancy.labels(phase="phase2").observe(float(len(live)))
@@ -1235,18 +1431,27 @@ def serve_forever(
                 journal.dispatched([e.request_id], batch_index, vnow,
                                    phase=2)
             dispatch_ms = vnow
+            if flight is not None:
+                flight.wait(e.request_id, "requeue_wait", dispatch_ms,
+                            pool="phase2", isolated=True)
             fault = (chaos.take(batch_index, [e.request_id])
                      if chaos is not None else None)
             try:
                 t0 = timer()
-                with span("serve.isolate_retry", batch=batch_index,
-                          lanes=bucket, request=e.request_id, phase=2):
+                with _trace_attach([e]), \
+                        span("serve.isolate_retry", batch=batch_index,
+                             lanes=bucket, request=e.request_id, phase=2):
                     imgs, run_ms, hit, _, finite = run_entries(
                         [e], compile_key, guidance, bucket, fault=fault)
                 compile_ms = max(0.0, (timer() - t0) * 1000.0 - run_ms)
             except Exception as exc:  # noqa: BLE001 — classified below
-                vnow += (timer() - t0) * 1000.0
+                elapsed = (timer() - t0) * 1000.0
+                vnow += elapsed
                 kind, reason = _fault_verdict(exc)
+                if flight is not None:
+                    flight.segment(e.request_id, "fault", vnow - elapsed,
+                                   elapsed, pool="phase2", kind=kind,
+                                   isolated=True)
                 batch_err = f"{type(batch_exc).__name__}: {batch_exc}"
                 if kind == faults_mod.TIMEOUT:
                     _note_timeout(compile_key, bucket)
@@ -1267,7 +1472,14 @@ def serve_forever(
                     "error", e.request_id, arrival_ms=e.arrival_ms,
                     reason=reason, batch_error=batch_err)
                 continue
+            v0 = vnow
             vnow += compile_ms + run_ms
+            if flight is not None:
+                flight.segment(e.request_id, "compile", v0, compile_ms,
+                               pool="phase2", cache_hit=hit, isolated=True)
+                flight.segment(e.request_id, "run", v0 + compile_ms,
+                               run_ms, pool="phase2", batch_id=batch_index,
+                               isolated=True)
             occupancies.append(1)
             occ_by_phase["phase2"].append(1)
             m_occupancy.labels(phase="phase2").observe(1.0)
@@ -1312,6 +1524,9 @@ def serve_forever(
                 if journal is not None:
                     journal.event("degrade", level=degrade_level,
                                   depth=depth, vnow_ms=round(vnow, 3))
+                if flight is not None:
+                    flight.loop_event("degrade", vnow, level=degrade_level,
+                                      depth=depth)
                 _apply_degrade_level()
         else:
             pressure_since = None
@@ -1327,6 +1542,9 @@ def serve_forever(
                 if journal is not None:
                     journal.event("restore", level=degrade_level,
                                   depth=depth, vnow_ms=round(vnow, 3))
+                if flight is not None:
+                    flight.loop_event("restore", vnow, level=degrade_level,
+                                      depth=depth)
                 _apply_degrade_level()
 
     def _apply_degrade_level() -> None:
@@ -1370,6 +1588,11 @@ def serve_forever(
                     # request was never force-gated, it never ran.
                     forced_gate_ids.add(item.request_id)
                     m_degraded_gate.inc()
+                if flight is not None:
+                    flight.admit(item.request_id, vnow,
+                                 arrival_ms=max(0.0, item.arrival_ms),
+                                 gated=prep.gated and phase_pools,
+                                 forced_gate=forced_gate)
                 if journal is not None:
                     journal.admitted(item.to_dict(), vnow)
             except (Rejected, ValueError) as e:
@@ -1437,6 +1660,8 @@ def serve_forever(
                 # Fatal fault: drain cleanly — terminal records for every
                 # outstanding request, then the summary. Nothing is left
                 # wedged; a journaled restart re-serves what never ran.
+                # (The blackbox already dumped at the fault itself, inside
+                # _fault_verdict, while the doomed contexts were open.)
                 leftover = [e for _, b in ordered[bi + 1:]
                             for e in b.entries]
                 leftover += [e for b in batcher.flush_all(vnow)

@@ -159,12 +159,20 @@ class Journal:
         self._append(rec)
 
     def handoff(self, request_id: str, vnow: float, carry_path: str,
-                spec: str) -> None:
+                spec: str, trace: dict = None) -> None:
         """One gated request crossed the phase boundary; its carry spill at
-        ``carry_path`` (already durably written) matches ``spec``."""
-        self._append({"type": HANDOFF, "id": request_id,
-                      "carry_path": carry_path, "spec": spec,
-                      "vnow_ms": round(vnow, 3)})
+        ``carry_path`` (already durably written) matches ``spec``.
+        ``trace`` is the request's flight-trace context (``obs.flight``):
+        it rides the WAL so a crash-replayed request resumed in phase 2 by
+        a different process can stitch its timeline to the pre-crash
+        phase-1 segments (absent when flight tracing is off — the record
+        stays byte-identical to the pre-tracing schema)."""
+        rec = {"type": HANDOFF, "id": request_id,
+               "carry_path": carry_path, "spec": spec,
+               "vnow_ms": round(vnow, 3)}
+        if trace is not None:
+            rec["trace"] = trace
+        self._append(rec)
 
     def carry_path(self, request_id: str) -> str:
         """Where this WAL spills a request's hand-off carry: a sidecar dir

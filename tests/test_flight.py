@@ -1,0 +1,460 @@
+"""Request-scoped flight tracing (ISSUE 7): per-request timelines across
+the two-pool serve engine, crash-stitched across replay, with the
+disabled-invisible and determinism contracts.
+
+Layers of proof:
+
+1. **Neutrality** — ``flight=None`` vs a live tracer: the serve record
+   stream is byte-identical, outputs bitwise; the tracer is a sidecar.
+2. **Attribution** — every ``ok`` flight record's stage segments (queue
+   wait / fault / backoff / compile / run / hand-off wait / re-queue
+   wait) tile the request's virtual-clock lifetime exactly, across the
+   monolithic path, the two-pool path, transient retries and poison
+   isolation — and the segment sums reconcile with the PR 3 stage
+   histograms.
+3. **Determinism** — same trace + fake runner/virtual timer ⇒
+   byte-identical flight-record JSONL across runs, including the
+   crash-resumed stitched timeline (real runners under a frozen injected
+   timer).
+4. **Artifacts** — the Chrome-trace export is structurally sound (pool
+   tracks, paired async events, hand-off flow arrows) and the blackbox
+   bundle preserves the in-flight contexts a fatal drain is about to
+   resolve.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from p2p_tpu.obs import flight as flight_mod
+from p2p_tpu.obs import metrics as metrics_mod
+from p2p_tpu.serve import Journal, Request, serve_forever
+from tests.test_handoff import PhaseFakeRunner, _gated_req
+from tests.test_serve import VirtualTimer
+
+
+@pytest.fixture(scope="module")
+def tiny_pipe():
+    from p2p_tpu.analysis.contracts import tiny_pipeline
+
+    return tiny_pipeline()
+
+
+def _mixed_trace(n_gated=4, n_plain=2):
+    reqs = [_gated_req(f"g{i}", arrival=i * 10.0, gate=0.5, seed=1)
+            for i in range(n_gated)]
+    reqs += [_gated_req(f"u{i}", arrival=i * 10.0, gate=None, seed=1)
+             for i in range(n_plain)]
+    reqs.sort(key=lambda r: r.arrival_ms)
+    return reqs
+
+
+def _fake_serve(pipe, reqs, tracer=None, timer=None, **kw):
+    timer = timer or VirtualTimer()
+
+    def factory(compile_key, bucket):
+        return PhaseFakeRunner(compile_key, bucket, timer)
+
+    return list(serve_forever(pipe, reqs, runner_factory=factory,
+                              timer=timer, flight=tracer, **kw))
+
+
+def _strip(recs):
+    return [{k: v for k, v in r.items() if k != "images"} for r in recs]
+
+
+def _flight_jsonl(tracer):
+    return "\n".join(json.dumps(r) for r in tracer.records)
+
+
+# ---------------------------------------------------------------------------
+# Neutrality: tracing on never changes the record stream
+# ---------------------------------------------------------------------------
+
+
+def test_record_stream_byte_identical_with_tracer(tiny_pipe):
+    off = _fake_serve(tiny_pipe, _mixed_trace(), max_batch=2,
+                      max_wait_ms=15.0)
+    tracer = flight_mod.FlightTracer()
+    on = _fake_serve(tiny_pipe, _mixed_trace(), tracer=tracer, max_batch=2,
+                     max_wait_ms=15.0)
+    assert json.dumps(_strip(off)) == json.dumps(_strip(on))
+    # One flight record per terminal, none invented.
+    terminals = [r for r in on if r["status"] not in (None, "summary")
+                 and r["request_id"] is not None]
+    assert len(tracer.records) == len(terminals)
+
+
+def test_flight_records_byte_deterministic(tiny_pipe):
+    def run():
+        tracer = flight_mod.FlightTracer()
+        _fake_serve(tiny_pipe, _mixed_trace(), tracer=tracer, max_batch=2,
+                    max_wait_ms=15.0, phase2_max_batch=4)
+        return _flight_jsonl(tracer)
+
+    assert run() == run()
+
+
+# ---------------------------------------------------------------------------
+# Attribution: segments tile the virtual-clock lifetime
+# ---------------------------------------------------------------------------
+
+
+def test_gated_causal_chain_and_exact_attribution(tiny_pipe):
+    """The ISSUE 7 acceptance: a gated request's flight record covers
+    admission → phase-1 dispatch → hand-off → phase-2 dispatch → terminal
+    and its stage durations sum to the recorded total, exactly, under the
+    virtual clock."""
+    tracer = flight_mod.FlightTracer()
+    recs = _fake_serve(tiny_pipe, _mixed_trace(), tracer=tracer,
+                       max_batch=2, max_wait_ms=15.0)
+    ok = {r["request_id"]: r for r in recs if r["status"] == "ok"}
+    assert len(ok) == 6
+    by_id = {r["request_id"]: r for r in tracer.records}
+    for rid, rec in by_id.items():
+        assert rec["status"] == "ok"
+        assert rec["trace_id"] == f"{rid}#0"
+        assert rec["attribution_ok"], rec
+        # total matches the serve record's own latency exactly.
+        assert rec["total_ms"] == pytest.approx(ok[rid]["total_ms"])
+        kinds = [e["kind"] for e in rec["events"]]
+        assert kinds[0] == "admitted" and kinds[-1] == "terminal"
+        stages = [(s["stage"], s.get("pool")) for s in rec["segments"]]
+        if rec["gated"]:
+            assert "handoff" in kinds
+            assert stages[0] == ("queue_wait", "phase1")
+            assert ("run", "phase1") in stages
+            assert ("handoff_wait", "phase2") in stages
+            assert ("run", "phase2") in stages
+            # Causally ordered: phase-1 run before the hand-off wait.
+            assert (stages.index(("run", "phase1"))
+                    < stages.index(("handoff_wait", "phase2")))
+        else:
+            assert stages[0] == ("queue_wait", "mono")
+            assert ("run", "mono") in stages
+            assert "handoff" not in kinds
+        # Segments are contiguous from arrival to terminal.
+        cursor = rec["arrival_ms"]
+        for seg in rec["segments"]:
+            assert seg["start_ms"] == pytest.approx(cursor)
+            cursor = seg["start_ms"] + seg["dur_ms"]
+        assert cursor == pytest.approx(rec["terminal_ms"])
+
+
+def test_transient_retry_attribution_includes_fault_and_backoff(tiny_pipe):
+    from p2p_tpu.serve.chaos import FaultPlan
+
+    tracer = flight_mod.FlightTracer()
+    reqs = [_gated_req("a", arrival=0.0, gate=None),
+            _gated_req("b", arrival=0.0, gate=None)]
+    recs = _fake_serve(tiny_pipe, reqs, tracer=tracer, max_batch=2,
+                       max_wait_ms=5.0, chaos=FaultPlan(
+                           by_batch={1: "transient"}))
+    assert {r["request_id"] for r in recs if r["status"] == "ok"} == \
+        {"a", "b"}
+    for rec in tracer.records:
+        stages = [s["stage"] for s in rec["segments"]]
+        assert stages == ["queue_wait", "fault", "backoff", "compile",
+                          "run"]
+        assert rec["attribution_ok"], rec
+        fault = rec["segments"][1]
+        assert fault["kind"] == "transient" and fault["attempt"] == 0
+
+
+def test_poison_isolation_attribution_and_victim_error(tiny_pipe):
+    from p2p_tpu.serve.chaos import FaultPlan
+
+    tracer = flight_mod.FlightTracer()
+    reqs = [_gated_req("good", arrival=0.0, gate=None),
+            _gated_req("bad", arrival=0.0, gate=None)]
+    recs = _fake_serve(tiny_pipe, reqs, tracer=tracer, max_batch=2,
+                       max_wait_ms=5.0, chaos=FaultPlan(
+                           by_request={"bad": "poison"}))
+    by = {r["request_id"]: r for r in recs
+          if r.get("request_id") in ("good", "bad")}
+    assert by["good"]["status"] == "ok"
+    assert by["bad"]["status"] == "error"
+    flights = {r["request_id"]: r for r in tracer.records}
+    good = flights["good"]
+    stages = [s["stage"] for s in good["segments"]]
+    # Batch fault, then the survivor's solo re-run — all attributed.
+    assert stages == ["queue_wait", "fault", "requeue_wait", "compile",
+                      "run"]
+    assert good["attribution_ok"], good
+    assert any(s.get("isolated") for s in good["segments"])
+    bad = flights["bad"]
+    assert bad["status"] == "error"
+    assert [s["stage"] for s in bad["segments"]][:2] == \
+        ["queue_wait", "fault"]
+
+
+def test_flight_attribution_reconciles_with_stage_histograms(tiny_pipe):
+    """The satellite contract: flight-record attribution and the PR 3
+    stage histograms tell the same story — per-stage segment sums equal
+    the ``serve_queue_wait_ms``/``serve_run_ms``/``serve_request_total_ms``
+    sums, and each record's total lands within one bucket of the
+    histogram's view."""
+    reg = metrics_mod.registry()
+    reg.reset()
+    tracer = flight_mod.FlightTracer()
+    n = 8
+    reqs = [_gated_req(f"r{i}", arrival=i * 20.0, gate=None, seed=1)
+            for i in range(n)]
+    _fake_serve(tiny_pipe, reqs, tracer=tracer, max_batch=4,
+                max_wait_ms=30.0)
+    assert len(tracer.records) == n
+
+    def seg_sum(stage):
+        return sum(s["dur_ms"] for r in tracer.records
+                   for s in r["segments"] if s["stage"] == stage)
+
+    def hist(name):
+        return reg.get(name).labels(phase="mono")
+
+    assert hist("serve_queue_wait_ms").sum == \
+        pytest.approx(seg_sum("queue_wait"))
+    assert hist("serve_run_ms").sum == pytest.approx(seg_sum("run"))
+    total = hist("serve_request_total_ms")
+    assert total.sum == pytest.approx(
+        sum(r["total_ms"] for r in tracer.records))
+    for rec in tracer.records:
+        # Same value observed by both surfaces ⇒ same bucket (the repo's
+        # stated histogram resolution).
+        assert total.bucket_index(rec["total_ms"]) == \
+            total.bucket_index(rec["attributed_ms"])
+
+
+def test_duplicate_id_rejection_keeps_live_context(tiny_pipe):
+    tracer = flight_mod.FlightTracer()
+    reqs = [_gated_req("dup", arrival=0.0, gate=None),
+            _gated_req("dup", arrival=1.0, gate=None)]
+    recs = _fake_serve(tiny_pipe, reqs, tracer=tracer, max_batch=2,
+                       max_wait_ms=5.0)
+    by_status = {}
+    for r in recs:
+        by_status.setdefault(r["status"], []).append(r)
+    assert len(by_status["rejected"]) == 1
+    assert len(by_status["ok"]) == 1
+    # Exactly ONE flight record — the served original; the duplicate's
+    # rejection must not have closed (or replaced) the live context.
+    assert [r["status"] for r in tracer.records] == ["ok"]
+    assert tracer.records[0]["attribution_ok"]
+
+
+# ---------------------------------------------------------------------------
+# Crash between phases: the stitched timeline
+# ---------------------------------------------------------------------------
+
+
+def _crash_then_resume(pipe, tmp, seeds=(100, 101)):
+    """Journaled run that dies at the phase-2 dispatch, then a restarted
+    run against the same WAL — both under a frozen injected timer, so the
+    flight records are fully deterministic."""
+    from tests.test_handoff import _crash_at_phase2_factory
+
+    wal = os.path.join(tmp, "crash.wal")
+    reqs = [_gated_req(f"g{i}", gate=0.5, seed=s)
+            for i, s in enumerate(seeds)]
+    t1 = flight_mod.FlightTracer()
+    j1 = Journal(wal)
+    gen = serve_forever(pipe, list(reqs), journal=j1, flight=t1,
+                        runner_factory=_crash_at_phase2_factory(pipe),
+                        timer=lambda: 0.0, max_batch=2, max_wait_ms=5.0)
+    with pytest.raises(KeyboardInterrupt):
+        list(gen)
+    j1._f.close()          # simulated process death: no clean close
+    t2 = flight_mod.FlightTracer()
+    j2 = Journal(wal)
+    recs = list(serve_forever(pipe, list(reqs), journal=j2, flight=t2,
+                              timer=lambda: 0.0, max_batch=2,
+                              max_wait_ms=5.0))
+    j2.close()
+    return wal, recs, t2
+
+
+def test_handoff_journal_carries_trace_context(tiny_pipe, tmp_path):
+    wal, _, _ = _crash_then_resume(tiny_pipe, str(tmp_path))
+    handoffs = [json.loads(l) for l in open(wal)
+                if json.loads(l)["type"] == "handoff"]
+    assert handoffs
+    for h in handoffs:
+        trace = h["trace"]
+        assert trace["trace_id"] == h["id"] + "#0"
+        stages = [s["stage"] for s in trace["segments"]]
+        assert "queue_wait" in stages and "run" in stages
+        assert any(e["kind"] == "handoff" for e in trace["events"])
+
+
+def test_crash_resume_yields_single_stitched_timeline(tiny_pipe, tmp_path):
+    """Mid-hand-off crash ⇒ the replayed request's flight record is
+    exactly-once and stitched: epoch 1, a ``handoff_resumed`` link naming
+    the pre-crash trace, phase-1 segments under epoch 0, phase-2 segments
+    under epoch 1, attribution exact for the resumed incarnation."""
+    _, recs, tracer = _crash_then_resume(tiny_pipe, str(tmp_path))
+    ok = [r for r in recs if r["status"] == "ok"]
+    assert sorted(r["request_id"] for r in ok) == ["g0", "g1"]
+    assert len(tracer.records) == 2          # exactly once
+    for rec in tracer.records:
+        rid = rec["request_id"]
+        assert rec["trace_id"] == f"{rid}#1" and rec["epoch"] == 1
+        assert rec["resumed"] is True
+        assert rec["links"] == [{"kind": "handoff_resumed",
+                                 "from": f"{rid}#0"}]
+        pre = [s for s in rec["segments"] if s["epoch"] == 0]
+        post = [s for s in rec["segments"] if s["epoch"] == 1]
+        assert [s["stage"] for s in pre][:1] == ["queue_wait"]
+        assert any(s["stage"] == "run" and s.get("pool") == "phase1"
+                   for s in pre)
+        assert [s["stage"] for s in post][0] == "handoff_wait"
+        assert any(s["stage"] == "run" and s.get("pool") == "phase2"
+                   for s in post)
+        kinds = [e["kind"] for e in rec["events"]]
+        assert "handoff_resumed" in kinds
+        assert rec["attribution_ok"], rec
+
+
+def test_crash_stitched_timeline_byte_deterministic(tiny_pipe, tmp_path):
+    _, _, a = _crash_then_resume(tiny_pipe, str(tmp_path / "a"))
+    _, _, b = _crash_then_resume(tiny_pipe, str(tmp_path / "b"))
+    assert _flight_jsonl(a) == _flight_jsonl(b)
+
+
+# ---------------------------------------------------------------------------
+# Artifacts: Chrome trace + blackbox
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_structure(tiny_pipe):
+    tracer = flight_mod.FlightTracer()
+    _fake_serve(tiny_pipe, _mixed_trace(), tracer=tracer, max_batch=2,
+                max_wait_ms=15.0)
+    doc = flight_mod.chrome_trace(tracer)
+    evs = doc["traceEvents"]
+    names = {e["args"]["name"] for e in evs if e["ph"] == "M"
+             and e["name"] == "thread_name"}
+    assert names == {"pool:mono", "pool:phase1", "pool:phase2"}
+    # Async request spans pair up.
+    begins = [e for e in evs if e["ph"] == "b"]
+    ends = [e for e in evs if e["ph"] == "e"]
+    assert len(begins) == len(ends) == len(tracer.records)
+    assert {e["id"] for e in begins} == {r["trace_id"]
+                                         for r in tracer.records}
+    # One hand-off flow arrow (s→f, phase1 track → phase2 track) per
+    # gated request.
+    starts = [e for e in evs if e["ph"] == "s"]
+    finishes = [e for e in evs if e["ph"] == "f"]
+    n_gated = sum(1 for r in tracer.records if r["gated"])
+    assert len(starts) == len(finishes) == n_gated
+    assert all(e["tid"] == 2 for e in starts)      # phase-1 track
+    assert all(e["tid"] == 3 for e in finishes)    # phase-2 track
+    # Every segment landed on its pool's track.
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == sum(len(r["segments"]) for r in tracer.records)
+    # Deterministic export: same records ⇒ same JSON.
+    assert json.dumps(doc) == json.dumps(flight_mod.chrome_trace(tracer))
+
+
+def test_chrome_trace_rebases_crash_stitched_timelines(tiny_pipe,
+                                                       tmp_path):
+    """A resumed record's pre-crash segments carry the previous process's
+    clock; the export must rebase them so the hand-off flow arrow points
+    forward in time and every segment sits inside the request's async
+    span — with no negative timestamps."""
+    _, _, tracer = _crash_then_resume(tiny_pipe, str(tmp_path))
+    assert all(r["resumed"] for r in tracer.records)
+    doc = flight_mod.chrome_trace(tracer)
+    evs = doc["traceEvents"]
+    ts_events = [e for e in evs if "ts" in e]
+    assert min(e["ts"] for e in ts_events) >= 0
+    by_id = {}
+    for e in evs:
+        if e["ph"] in "sf":
+            by_id.setdefault(e["id"], {})[e["ph"]] = e
+    assert len(by_id) == len(tracer.records)
+    for pair in by_id.values():
+        assert pair["s"]["ts"] <= pair["f"]["ts"], pair   # forward flow
+    # Every segment lands within [async begin, async end] of its request.
+    spans = {}
+    for e in evs:
+        if e["ph"] == "b":
+            spans.setdefault(e["id"], {})["b"] = e["ts"]
+        elif e["ph"] == "e":
+            spans.setdefault(e["id"], {})["e"] = e["ts"]
+    for e in evs:
+        if e["ph"] == "X":
+            span = spans[e["args"]["trace_id"]]
+            assert span["b"] <= e["ts"] <= e["ts"] + e["dur"] <= span["e"]
+
+
+def test_serve_cli_rejects_bad_events_ring(tmp_path, monkeypatch):
+    from p2p_tpu.cli import main
+
+    req_path = str(tmp_path / "reqs.jsonl")
+    with open(req_path, "w") as f:
+        f.write(json.dumps({"request_id": "r", "prompt": "a cat",
+                            "steps": 2}) + "\n")
+    with pytest.raises(SystemExit, match="events-ring must be >= 1"):
+        main(["serve", "--quiet", "--requests", req_path,
+              "--events-ring", "0"])
+    monkeypatch.setenv("P2P_OBS_EVENTS_RING", "abc")
+    with pytest.raises(SystemExit, match="must be an integer"):
+        main(["serve", "--quiet", "--requests", req_path])
+
+
+def test_blackbox_bundle_on_fatal_drain(tiny_pipe, tmp_path):
+    from p2p_tpu.serve.chaos import FaultPlan
+
+    bb = str(tmp_path / "bb")
+    tracer = flight_mod.FlightTracer(blackbox_dir=bb)
+    reqs = [_gated_req("a", arrival=0.0, gate=None),
+            _gated_req("b", arrival=0.0, gate=None),
+            _gated_req("late", arrival=5.0, gate=None, steps=5)]
+    recs = _fake_serve(tiny_pipe, reqs, tracer=tracer, max_batch=2,
+                       max_wait_ms=2.0, chaos=FaultPlan(
+                           by_batch={1: "fatal"}))
+    assert all(r["status"] == "error" for r in recs
+               if r.get("request_id"))
+    (bundle,) = tracer.blackbox_bundles
+    assert os.path.basename(bundle).startswith("000_fatal_fault")
+    state = json.load(open(os.path.join(bundle, "state.json")))
+    assert state["reason"] == "fatal_fault"
+    assert state["state"]["outstanding"] >= 2
+    assert any(e["kind"] == "fatal" for e in state["loop_events"])
+    # The doomed requests' contexts were still in flight at dump time.
+    inflight = [json.loads(l)
+                for l in open(os.path.join(bundle, "inflight.jsonl"))]
+    assert {c["request_id"] for c in inflight} >= {"a", "b"}
+    # Span ring tail, meta line first.
+    with open(os.path.join(bundle, "events.jsonl")) as f:
+        first = json.loads(f.readline())
+    assert first["event"] == "meta" and "dropped" in first
+
+
+def test_serve_cli_flight_artifacts(tmp_path):
+    from p2p_tpu.cli import main
+
+    req_path = str(tmp_path / "reqs.jsonl")
+    with open(req_path, "w") as f:
+        f.write(json.dumps({"request_id": "r1", "prompt": "a cat",
+                            "steps": 2, "gate": 0.5,
+                            "arrival_ms": 0}) + "\n")
+        f.write(json.dumps({"request_id": "r2", "prompt": "a dog",
+                            "steps": 2, "arrival_ms": 1.0}) + "\n")
+    flights = str(tmp_path / "flights.jsonl")
+    trace = str(tmp_path / "trace.json")
+    results = str(tmp_path / "out.jsonl")
+    assert main(["serve", "--quiet", "--requests", req_path,
+                 "--results", results, "--flight-out", flights,
+                 "--trace-out", trace, "--events-ring", "512"]) == 0
+    recs = [json.loads(l) for l in open(flights)]
+    assert sorted(r["request_id"] for r in recs) == ["r1", "r2"]
+    gated = [r for r in recs if r["request_id"] == "r1"][0]
+    assert gated["gated"] and gated["attribution_ok"]
+    assert any(e["kind"] == "handoff" for e in gated["events"])
+    doc = json.load(open(trace))
+    assert doc["traceEvents"]
+    # The serve result stream itself never mentions the tracer.
+    out = [json.loads(l) for l in open(results)]
+    assert all("flight" not in r and "trace_id" not in r for r in out)

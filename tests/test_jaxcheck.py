@@ -606,7 +606,48 @@ def test_canonical_contracts_hold_on_session_pipeline(tiny_pipe):
     # The suite must actually cover each contract class.
     kinds = {r.contract for r in results}
     assert kinds == {"no-f64", "hot-scan-callbacks", "phase2-footprint",
-                     "donation-as-declared"}
+                     "donation-as-declared", "trace-invisible"}
+
+
+def test_trace_invisible_covers_every_canonical_program(tiny_pipe):
+    """The flight-tracing disabled-invisible sweep (ISSUE 7): every
+    canonical program's fingerprint is identical with a live tracer."""
+    from p2p_tpu.analysis.contracts import (canonical_programs,
+                                            check_trace_invisible)
+
+    results = check_trace_invisible(tiny_pipe, buckets=(1,))
+    assert all(r.ok for r in results), [r.format() for r in results]
+    names = {p.name for p in canonical_programs(tiny_pipe, buckets=(1,))}
+    assert {r.program for r in results} == names
+
+
+def test_trace_invisible_flags_a_tracer_dependent_program(tiny_pipe):
+    """Verdict-flip proof: a program whose jaxpr DEPENDS on the flight
+    layer's state (the regression this contract exists for) is a hard
+    error naming exactly that program."""
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_tpu.analysis.contracts import Program, check_trace_invisible
+
+    state = {"live": False}
+
+    def poisoned_programs(pipe, buckets=(1,), metrics=False):
+        # First call = the quiescent baseline; second call (under the live
+        # tracer) grows an extra op — exactly what "tracing on changed the
+        # program" looks like.
+        def f(x):
+            return x * 2 + 1 if state["live"] else x * 2
+
+        jaxpr = jax.make_jaxpr(f)(jnp.float32(1.0))
+        state["live"] = True
+        return [Program("probe", jaxpr, group_batch=1, gate=None,
+                        metrics=metrics)]
+    results = check_trace_invisible(tiny_pipe, buckets=(1,),
+                                    programs_fn=poisoned_programs)
+    assert len(results) == 1 and not results[0].ok
+    assert results[0].program == "probe"
+    assert "fingerprint changed" in results[0].detail
 
 
 # ---------------------------------------------------------------------------

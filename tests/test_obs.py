@@ -191,6 +191,61 @@ def test_span_disabled_is_pass_through():
         spans_mod.set_enabled(True)
 
 
+def test_span_ring_capacity_configurable_and_drop_count_stays_honest():
+    """ISSUE 7 satellite: two-pool serving roughly doubles event volume,
+    so the ring is sizeable (``serve --events-ring`` /
+    ``P2P_OBS_EVENTS_RING``) — and resizing must keep the meta line's
+    ``dropped`` count truthful: ``total`` survives a resize, a shrink
+    counts its evictions exactly like organic overflow."""
+    rec = spans_mod.SpanRecorder(capacity=8)
+    for i in range(10):
+        rec.emit({"event": "span_start", "i": i})
+    assert rec.dropped == 2
+    rec.resize(4)                       # shrink: 4 more evicted, counted
+    assert rec.capacity == 4
+    assert [e["i"] for e in rec.events()] == [6, 7, 8, 9]
+    assert rec.total == 10 and rec.dropped == 6
+    rec.resize(16)                      # grow: nothing lost, count kept
+    assert rec.dropped == 6
+    for i in range(10, 14):
+        rec.emit({"event": "span_start", "i": i})
+    assert len(rec.events()) == 8 and rec.total == 14 and rec.dropped == 6
+    with pytest.raises(ValueError, match="capacity"):
+        rec.resize(0)
+    # The module-level knob targets the process recorder.
+    old_cap = spans_mod.capacity()
+    try:
+        spans_mod.set_capacity(512)
+        assert spans_mod.capacity() == 512
+    finally:
+        spans_mod.set_capacity(old_cap)
+
+
+def test_span_attach_stamps_context_attributes():
+    """ISSUE 7: ``spans.attach`` rides request identity into every span
+    opened inside the block (start AND end events), nested attaches merge
+    innermost-wins, and explicit span attrs beat attached ones."""
+    spans_mod.clear()
+    with spans_mod.attach(traces="r1#0", pool="phase1"):
+        with spans_mod.span("serve.batch", lanes=2):
+            pass
+        with spans_mod.attach(pool="phase2"):
+            with spans_mod.span("serve.batch", pool="explicit"):
+                pass
+    with spans_mod.span("serve.batch"):
+        pass
+    evs = spans_mod.events()
+    first_start, first_end = evs[0], evs[1]
+    assert first_start["traces"] == "r1#0" and first_start["pool"] == \
+        "phase1"
+    assert first_end["traces"] == "r1#0" and first_start["lanes"] == 2
+    nested_start = evs[2]
+    assert nested_start["traces"] == "r1#0"
+    assert nested_start["pool"] == "explicit"   # span attrs win
+    outside = evs[4]
+    assert "traces" not in outside              # attach scope ended
+
+
 # ---------------------------------------------------------------------------
 # Serve loop: registry aggregates reconcile with the record stream
 # ---------------------------------------------------------------------------

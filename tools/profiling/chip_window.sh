@@ -32,13 +32,31 @@ run() { # name timeout cmd...
 # passed. The tool itself is the probe — a separate probe client's exit
 # would just re-open the hole it was checking for. bench.py steps don't
 # need this: their parent probe rides the hole out internally.
+#
+# Retry loop (ADVICE r5): up to 3 attempts total, and the sleep is keyed
+# off the REFUSAL timestamp (the log's mtime — when the refused tool
+# exited), not off "now": a fixed 300s from an arbitrary later point can
+# land the retry inside a fresh hole that the previous attempt's own exit
+# just re-opened. We wait until ~330s after the refusal, which clears the
+# measured ~4.5-min hole with margin however long the bookkeeping between
+# attempts took.
 run_tool() { # name leash cmd...
-  local name="$1"
+  local name="$1" attempt ref_ts now wait
   run "$@"
-  if grep -q "profiling refused" "$L/$name.log"; then
-    echo "=== $name hit the lease hole; retrying in 300s" | tee -a "$L/runner.log"
-    sleep 300
+  for attempt in 2 3; do
+    grep -q "profiling refused" "$L/$name.log" || return 0
+    ref_ts=$(stat -c %Y "$L/$name.log" 2>/dev/null || date +%s)
+    now=$(date +%s)
+    wait=$(( ref_ts + 330 - now ))
+    [ "$wait" -lt 10 ] && wait=10
+    echo "=== $name hit the lease hole; attempt $attempt/3 in ${wait}s" \
+      | tee -a "$L/runner.log"
+    sleep "$wait"
     run "$@"
+  done
+  if grep -q "profiling refused" "$L/$name.log"; then
+    echo "=== $name still refused after 3 attempts; moving on" \
+      | tee -a "$L/runner.log"
   fi
 }
 

@@ -157,6 +157,71 @@ def _fault_drill():
     return res
 
 
+def _flight_parity():
+    """The flight-tracing neutrality contract (ISSUE 7): serving the same
+    trace with a FlightTracer attached must leave (1) every output image
+    bitwise identical and (2) the serve JSONL record stream byte-identical
+    to the tracer-off run — tracing is a sidecar, never a behavior change —
+    while (3) producing one flight record per terminal whose gated causal
+    chain covers admission → phase-1 dispatch → hand-off → phase-2
+    dispatch → terminal and whose stage attribution sums to the recorded
+    total. Returns (records_identical, images_identical, n_flights,
+    n_attr_ok, gated_chain_ok)."""
+    import json
+
+    import numpy as np
+
+    from p2p_tpu.obs.flight import FlightTracer
+    from p2p_tpu.serve import Request, serve_forever
+    from tests.test_golden import _pipe
+    from p2p_tpu.models import TINY
+
+    pipe = _pipe(TINY)
+    prompts = ["a squirrel eating a burger", "a squirrel eating a lasagna"]
+    reqs = [Request(request_id="fp-gated", prompt=prompts[0],
+                    target=prompts[1], mode="replace", steps=3, seed=42,
+                    gate=0.5, arrival_ms=0.0),
+            Request(request_id="fp-plain", prompt=prompts[0], steps=3,
+                    seed=7, arrival_ms=1.0)]
+
+    def run(tracer):
+        # Deterministic timer: both runs measure identical (zero) wall
+        # durations, so the byte-compare isolates the tracer's effect on
+        # the record stream instead of cross-run timing noise. Outputs
+        # still come from the real runners.
+        recs = list(serve_forever(pipe, list(reqs), max_batch=4,
+                                  max_wait_ms=1.0, timer=lambda: 0.0,
+                                  flight=tracer))
+        imgs = {r["request_id"]: r["images"] for r in recs
+                if r["status"] == "ok"}
+        stripped = [{k: v for k, v in r.items() if k != "images"}
+                    for r in recs]
+        return json.dumps(stripped, sort_keys=True), imgs
+
+    base_bytes, base_imgs = run(None)
+    tracer = FlightTracer()
+    on_bytes, on_imgs = run(tracer)
+    records_identical = base_bytes == on_bytes
+    images_identical = (set(base_imgs) == set(on_imgs) and all(
+        np.array_equal(base_imgs[k], on_imgs[k]) for k in base_imgs))
+    oks = [r for r in tracer.records if r["status"] == "ok"]
+    n_attr_ok = sum(1 for r in oks if r.get("attribution_ok"))
+    gated = [r for r in tracer.records if r["request_id"] == "fp-gated"]
+    chain_ok = False
+    if gated:
+        g = gated[0]
+        stages = [(s["stage"], s.get("pool")) for s in g["segments"]]
+        kinds = [e["kind"] for e in g["events"]]
+        chain_ok = (kinds[0] == "admitted" and "handoff" in kinds
+                    and kinds[-1] == "terminal"
+                    and ("run", "phase1") in stages
+                    and ("handoff_wait", "phase2") in stages
+                    and ("run", "phase2") in stages
+                    and g.get("attribution_ok") is True)
+    return (records_identical, images_identical, len(tracer.records),
+            n_attr_ok, chain_ok)
+
+
 def _obs_overhead(reps=4):
     """(overhead_frac, bitwise_identical, step_events) for the telemetry
     path (ISSUE 3): the same tiny sampling run with metrics enabled (step
@@ -259,6 +324,17 @@ def main(argv=None) -> int:
                          "numerics-neutral)")
     ap.add_argument("--skip-obs", action="store_true",
                     help="skip the telemetry-overhead check")
+    ap.add_argument("--skip-flight", action="store_true",
+                    help="skip the flight-tracing parity check (ISSUE 7)")
+    ap.add_argument("--bench-trend", action="store_true",
+                    help="also run the opt-in bench_trend check: diff the "
+                         "latest committed BENCH_r*.json round against its "
+                         "like-for-like predecessor on the headline keys "
+                         "(tools/benchwatch.py) and fail past "
+                         "--bench-trend-threshold")
+    ap.add_argument("--bench-trend-threshold", type=float, default=0.10,
+                    help="regression budget for --bench-trend (fraction; "
+                         "default 0.10)")
     ap.add_argument("--skip-fault-drill", action="store_true",
                     help="skip the chaos/crash-replay resilience check "
                          "(ISSUE 4; ~35s: it serves the standard trace "
@@ -283,11 +359,13 @@ def main(argv=None) -> int:
     if only:
         unknown = only - set(cases) - {"phase_gate", "serve_parity",
                                        "obs_overhead", "fault_drill",
-                                       "static_analysis"}
+                                       "static_analysis", "flight_parity",
+                                       "bench_trend"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
                      f"valid: {', '.join(cases)}, phase_gate, serve_parity, "
-                     f"obs_overhead, fault_drill, static_analysis")
+                     f"obs_overhead, fault_drill, static_analysis, "
+                     f"flight_parity, bench_trend")
 
     drifted = []
     for name, fn in cases.items():
@@ -327,6 +405,34 @@ def main(argv=None) -> int:
               f"{'ok' if ok else 'DRIFT'}")
         if not ok:
             drifted.append("serve_parity")
+
+    if not args.skip_flight and (only is None or "flight_parity" in only):
+        rec_id, img_id, n_flights, n_attr, chain = _flight_parity()
+        ok = rec_id and img_id and n_flights == 2 and n_attr == 2 and chain
+        print(f"{'flight_parity':16s} records "
+              f"{'byte-identical' if rec_id else 'DIFF'}, images "
+              f"{'bitwise' if img_id else 'DIFF'}, {n_flights} flight "
+              f"record(s), {n_attr} attribution-exact, gated chain "
+              f"{'covered' if chain else 'BROKEN'} "
+              f"{'ok' if ok else 'DRIFT'}")
+        if not ok:
+            drifted.append("flight_parity")
+
+    if args.bench_trend or (only is not None and "bench_trend" in only):
+        # Opt-in: the committed BENCH trajectory is only diffable when the
+        # latest round has a like-for-like predecessor, and most gate runs
+        # happen mid-round — so the trend watch runs on request, not by
+        # default.
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "p2p_benchwatch", os.path.join(_REPO, "tools", "benchwatch.py"))
+        benchwatch = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(benchwatch)
+        report = benchwatch.watch(_REPO, args.bench_trend_threshold)
+        print(benchwatch.render(report))
+        if report["regressions"]:
+            drifted.append("bench_trend")
 
     if not args.skip_obs and (only is None or "obs_overhead" in only):
         overhead, identical, steps = _obs_overhead()
