@@ -457,10 +457,15 @@ def cmd_serve(args) -> int:
 
     from .obs import metrics as obs_metrics
     from .obs import spans as obs_spans
-    from .serve import (DegradeConfig, FaultPlan, Journal, Request,
-                        parse_jsonl_line, serve_forever)
+    from .serve import (DegradeConfig, DrainController, FaultPlan, Journal,
+                        Request, parse_jsonl_line, serve_forever,
+                        signal_drain)
     from .utils.progress import trace as prof_trace
 
+    if args.snapshot_every_ms is not None and not args.journal:
+        # Fail fast, before the (expensive) pipeline build.
+        raise SystemExit("--snapshot-every-ms snapshots the journal: it "
+                         "needs --journal")
     # One serve run == one snapshot/event-log: reset before the pipeline
     # build so prewarm compiles and the queue/batcher/cache timelines are
     # all covered by the exported artifacts.
@@ -504,7 +509,7 @@ def cmd_serve(args) -> int:
     journal = Journal(args.journal) if args.journal else None
     chaos = FaultPlan.load(args.chaos_plan) if args.chaos_plan else None
     if chaos is not None:
-        # Two kinds are inert without their enabling flag: a drill that
+        # Some kinds are inert without their enabling flag: a drill that
         # "passes" without ever exercising the path is worse than one that
         # fails, so say so up front.
         kinds = set(chaos.by_batch.values()) | set(chaos.by_request.values())
@@ -516,6 +521,17 @@ def cmd_serve(args) -> int:
             print("warning: chaos plan injects 'hang' but --watchdog-ms is "
                   "unset — the hang degrades to a short stall and the "
                   "watchdog path is NOT being drilled", file=sys.stderr)
+        if "kill_during_snapshot" in kinds and (
+                not args.journal or args.snapshot_every_ms is None):
+            print("warning: chaos plan arms 'kill_during_snapshot' but "
+                  "periodic snapshots are off (--journal + "
+                  "--snapshot-every-ms) — the kill can only fire at a "
+                  "drain's final snapshot", file=sys.stderr)
+        if "kill_during_drain" in kinds and "sigterm" not in kinds:
+            print("warning: chaos plan arms 'kill_during_drain' with no "
+                  "'sigterm' to start a drain — it only fires if the "
+                  "operator drains (SIGTERM/SIGINT) mid-run",
+                  file=sys.stderr)
     degrade = None
     if args.degrade_depth is not None:
         degrade = DegradeConfig(depth_threshold=args.degrade_depth,
@@ -543,8 +559,15 @@ def cmd_serve(args) -> int:
         out.write(json.dumps(rec) + "\n")
         out.flush()
 
+    # Lifecycle: SIGTERM/Ctrl-C request a graceful drain (finish in-flight
+    # work, snapshot, emit the summary, exit 0); a second signal forces a
+    # KeyboardInterrupt, caught below so the operator never sees a raw
+    # traceback — artifacts still flush in the finally blocks and the
+    # journal's crash contract covers whatever the force-quit abandoned.
+    drain_ctl = DrainController()
+    interrupted = False
     try:
-        with prof_trace(args.profile):
+        with prof_trace(args.profile), signal_drain(drain_ctl):
             for rec in serve_forever(
                     pipe, items, max_batch=args.max_batch,
                     max_wait_ms=args.max_wait_ms, queue_cap=args.queue_cap,
@@ -556,8 +579,15 @@ def cmd_serve(args) -> int:
                     degrade=degrade,
                     phase_pools=not args.single_pool,
                     phase2_max_batch=args.phase2_max_batch,
-                    flight=flight_tracer):
+                    flight=flight_tracer,
+                    lifecycle=drain_ctl,
+                    snapshot_every_ms=args.snapshot_every_ms,
+                    drain_timeout_ms=args.drain_timeout_ms):
                 emit(rec)
+    except KeyboardInterrupt:
+        interrupted = True
+        print("serve: force quit before the drain completed (journaled "
+              "work resumes on restart)", file=sys.stderr)
     finally:
         if journal is not None:
             journal.close()
@@ -597,7 +627,7 @@ def cmd_serve(args) -> int:
                 else:
                     obs_spans.write_jsonl(f)
             print(f"wrote {path}", file=sys.stderr)
-    return 0
+    return 130 if interrupted else 0
 
 
 def cmd_check(args) -> int:
@@ -861,9 +891,26 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--journal", default=None, metavar="FILE",
                    help="crash-safe request journal (append-only JSONL WAL, "
                         "fsync'd at batch boundaries); restarting against "
-                        "the same file replays non-terminal requests "
-                        "exactly once and dedupes already-resolved ids "
-                        "(docs/SERVING.md)")
+                        "the same file warm-restarts from the snapshot + "
+                        "WAL tail: non-terminal requests replay exactly "
+                        "once, already-resolved ids are deduped "
+                        "(docs/SERVING.md#lifecycle)")
+    s.add_argument("--snapshot-every-ms", type=float, default=None,
+                   metavar="MS",
+                   help="periodic journal snapshot+compaction on the "
+                        "virtual clock (needs --journal): the replay-"
+                        "folded state is written atomically next to the "
+                        "WAL, the WAL rotates, and orphaned carry spills "
+                        "are garbage-collected — restart cost becomes "
+                        "O(traffic since the last snapshot)")
+    s.add_argument("--drain-timeout-ms", type=float, default=None,
+                   metavar="MS",
+                   help="wall-clock budget for the graceful drain "
+                        "(SIGTERM/Ctrl-C): past it the loop falls back to "
+                        "snapshot-and-exit — journaled leftovers stay "
+                        "pending for the warm restart, un-journaled ones "
+                        "resolve to 'rejected' draining records "
+                        "(default: unbounded)")
     s.add_argument("--chaos-plan", default=None, metavar="FILE",
                    help="deterministic fault-injection plan (JSON, see "
                         "p2p_tpu/serve/chaos.py; generator: tools/loadgen.py "
@@ -918,7 +965,13 @@ def main(argv=None) -> int:
     from .utils.cache import enable_persistent_cache
 
     enable_persistent_cache()
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except KeyboardInterrupt:
+        # Ctrl-C outside a command's own graceful path (serve drains; see
+        # cmd_serve) is a clean exit, never a raw traceback.
+        print("interrupted", file=sys.stderr)
+        return 130
 
 
 if __name__ == "__main__":

@@ -10,15 +10,19 @@ fault-tolerance layer — crash-safe journal + replay (``journal``), typed
 failure classification with bounded retries (``faults``), a dispatch-time
 watchdog, post-run output validation, graceful degradation under pressure,
 and the deterministic fault-injection harness (``chaos``) — rides the same
-loop and is fully off by default. See docs/SERVING.md.
+loop and is fully off by default. The lifecycle layer (``lifecycle`` +
+``journal.compact``) adds the orderly half: graceful drain on
+SIGTERM/SIGINT, periodic journal snapshot/compaction, and warm restart
+from snapshot + WAL tail. See docs/SERVING.md.
 """
 
 from .batcher import BUCKET_SIZES, DynamicBatcher, bucket_for
-from .chaos import FaultPlan
+from .chaos import FaultPlan, SimulatedKill
 from .engine_loop import DegradeConfig, serve_forever
 from .faults import InjectedFault, RetryPolicy, WatchdogTimeout, classify
 from .handoff import HandoffEntry
 from .journal import Journal, ReplayState, replay
+from .lifecycle import DrainController, signal_drain
 from .programs import ProgramCache
 from .queue import AdmissionQueue, Rejected
 from .request import Cancel, Request, parse_jsonl_line, prepare
@@ -28,6 +32,7 @@ __all__ = [
     "BUCKET_SIZES",
     "Cancel",
     "DegradeConfig",
+    "DrainController",
     "DynamicBatcher",
     "FaultPlan",
     "HandoffEntry",
@@ -38,6 +43,7 @@ __all__ = [
     "ReplayState",
     "Request",
     "RetryPolicy",
+    "SimulatedKill",
     "WatchdogTimeout",
     "bucket_for",
     "classify",
@@ -45,4 +51,5 @@ __all__ = [
     "prepare",
     "replay",
     "serve_forever",
+    "signal_drain",
 ]

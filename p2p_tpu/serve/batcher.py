@@ -133,8 +133,14 @@ class DynamicBatcher:
                     len(self._waiting[key]) >= self.max_batch:
                 out.append(self._pop(key, self.max_batch, now_ms))
                 self._m_flush.labels(cause="full", pool=self.pool).inc()
+            # Same arithmetic as next_flush_ms (oldest + max_wait), NOT
+            # `now - oldest >= max_wait`: the two can disagree in the last
+            # float ulp, and the engine advances its virtual clock to
+            # exactly next_flush_ms when idle — a mismatch leaves a bucket
+            # forever "almost aged" and the loop spinning (surfaced by the
+            # soak drill's long virtual horizons).
             if key in self._waiting and \
-                    now_ms - self._oldest_ms[key] >= self.max_wait_ms:
+                    now_ms >= self._oldest_ms[key] + self.max_wait_ms:
                 out.append(self._pop(key, self.max_batch, now_ms))
                 self._m_flush.labels(cause="age", pool=self.pool).inc()
         out.sort(key=lambda b: min(e.seq for e in b.entries))

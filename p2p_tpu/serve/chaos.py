@@ -26,6 +26,22 @@ Fault kinds and the path each one drills:
 - ``fatal`` — classified fatal → the loop drains with terminal records
   for everything outstanding.
 
+Lifecycle kinds (ISSUE 9) never reach the runner — they drill the drain /
+snapshot machinery instead:
+
+- ``sigterm`` — the dispatch where it fires requests a *graceful drain*
+  (exactly what a SIGTERM handler does): the batch itself runs normally,
+  then the loop stops admitting, finishes in-flight work, snapshots and
+  exits with its summary.
+- ``kill_during_drain`` — ARMS a process kill that fires after the next
+  drain-mode dispatch: :class:`SimulatedKill` propagates out of the
+  generator mid-drain (the drill closes the journal's raw handle, like a
+  real death), and the restart must still be exactly-once.
+- ``kill_during_snapshot`` — ARMS a kill inside the next
+  ``journal.compact``: the snapshot is durably renamed but the WAL never
+  rotates — the nastiest real crash window, which replay must fold
+  idempotently (snapshot ∪ overlapping WAL, duplicates collapsed).
+
 Plans are plain JSON (``{"by_batch": {"3": "transient"}, "by_request":
 {"r-07": "poison"}}``) so ``tools/loadgen.py`` can emit them next to a
 trace and ``p2p-tpu serve --chaos-plan`` can load them;
@@ -40,12 +56,29 @@ import json
 import random
 from typing import Dict, Optional, Sequence, Tuple
 
-KINDS = ("transient", "poison", "fatal", "hang", "nan")
+#: Lifecycle drill kinds: intercepted by the engine before the runner —
+#: ``sigterm`` requests a graceful drain at its dispatch; the two ``kill_*``
+#: kinds ARM a :class:`SimulatedKill` that fires at the next drain-mode
+#: dispatch / inside the next snapshot.
+SIGTERM = "sigterm"
+KILL_DURING_DRAIN = "kill_during_drain"
+KILL_DURING_SNAPSHOT = "kill_during_snapshot"
+LIFECYCLE_KINDS = (SIGTERM, KILL_DURING_DRAIN, KILL_DURING_SNAPSHOT)
+
+KINDS = ("transient", "poison", "fatal", "hang", "nan") + LIFECYCLE_KINDS
 
 #: Kinds that fire once and are then spent (a flake / a single hang / one
-#: fatal). ``poison`` and ``nan`` are properties of the *request* and keep
-#: firing as long as the victim id shows up.
-_ONE_SHOT = ("transient", "hang", "fatal")
+#: fatal / one lifecycle action). ``poison`` and ``nan`` are properties of
+#: the *request* and keep firing as long as the victim id shows up.
+_ONE_SHOT = ("transient", "hang", "fatal") + LIFECYCLE_KINDS
+
+
+class SimulatedKill(Exception):
+    """A chaos-injected process death (``kill_during_drain`` /
+    ``kill_during_snapshot``): propagates straight out of the serve
+    generator — no record, no summary, exactly like SIGKILL as far as the
+    journal is concerned. Drills catch it, close the journal's raw handle
+    and restart."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +109,7 @@ class FaultPlan:
                 raise ValueError(f"unknown fault kind {kind!r}; "
                                  f"valid: {', '.join(KINDS)}")
         self._fired: set = set()
+        self._armed_kills: set = set()
 
     def __len__(self) -> int:
         return len(self.by_batch) + len(self.by_request)
@@ -83,6 +117,24 @@ class FaultPlan:
     def reset(self) -> None:
         """Forget one-shot firing state (re-run the same plan)."""
         self._fired.clear()
+        self._armed_kills.clear()
+
+    # -- lifecycle kills ---------------------------------------------------
+    def arm_kill(self, kind: str) -> None:
+        """A ``kill_during_*`` fault was taken at its keyed dispatch: the
+        kill itself fires later, at the matching lifecycle point (the next
+        drain-mode dispatch / the next snapshot's durable moment)."""
+        if kind not in (KILL_DURING_DRAIN, KILL_DURING_SNAPSHOT):
+            raise ValueError(f"not a kill kind: {kind!r}")
+        self._armed_kills.add(kind)
+
+    def take_kill(self, kind: str) -> bool:
+        """Consume an armed kill of ``kind`` (one-shot); the caller raises
+        :class:`SimulatedKill`."""
+        if kind in self._armed_kills:
+            self._armed_kills.discard(kind)
+            return True
+        return False
 
     def take(self, batch_index: int, request_ids: Sequence[str]
              ) -> Optional[Fault]:

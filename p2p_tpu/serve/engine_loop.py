@@ -110,6 +110,22 @@ in-flight contexts and a pool/queue snapshot as a post-mortem bundle.
 journal bytes and the compiled programs are identical with the tracer off
 (tests/test_flight.py pins the parity; the ``trace-invisible`` jaxpr
 contract pins the program half).
+
+**Lifecycle** (``serve.lifecycle`` + ``journal.compact``): the loop can
+now *stop on purpose*. A drain request (SIGTERM/SIGINT via the CLI, a
+drill trigger, or a chaos ``sigterm`` fault) latches at the next cycle
+boundary: admissions stop (new arrivals — and, on exit, the not-yet-
+arrived trace tail — resolve to ``rejected`` with the ``draining`` kind,
+not journaled as terminal: backpressure, not a resolution), both
+batchers flush, in-flight work completes — including
+phase-2 hand-offs — bounded by ``drain_timeout_ms`` on the wall clock
+(past it: journaled leftovers stay pending for the warm restart,
+un-journaled ones resolve to draining rejections), then a final journal
+snapshot is taken and the summary closes the stream. ``snapshot_every_ms``
+additionally compacts the WAL periodically on the virtual clock, so a
+restart replays O(traffic since the last snapshot) instead of O(process
+history) and resumes the snapshot's degradation level. With all three
+off (the default), not a record, journal byte or program changes.
 """
 
 from __future__ import annotations
@@ -122,8 +138,10 @@ from typing import Callable, Iterable, Iterator, List, Optional
 from ..obs import metrics as obs_metrics
 from ..obs import spans as obs_spans
 from ..obs.spans import span
+from . import chaos as chaos_mod
 from . import faults as faults_mod
 from . import handoff as handoff_mod
+from . import lifecycle as lifecycle_mod
 from . import queue as queue_mod
 from .batcher import BUCKET_SIZES, Batch, DynamicBatcher, bucket_for
 from .faults import RetryPolicy
@@ -272,6 +290,9 @@ def serve_forever(
     phase_pools: bool = True,
     phase2_max_batch: Optional[int] = None,
     flight=None,
+    lifecycle=None,
+    snapshot_every_ms: Optional[float] = None,
+    drain_timeout_ms: Optional[float] = None,
 ) -> Iterator[dict]:
     """Drain ``requests`` (Request/Cancel objects or JSONL-shaped dicts,
     sorted by ``arrival_ms``) through the queue → batcher → program-cache →
@@ -307,6 +328,24 @@ def serve_forever(
     Chrome-trace export and the blackbox post-mortem (see the module
     docstring). Tracing is a pure sidecar — it never changes a record, a
     journal byte, or a compiled program.
+
+    Lifecycle (``serve.lifecycle``): ``lifecycle`` (a
+    :class:`~p2p_tpu.serve.lifecycle.DrainController`) enables the
+    graceful-drain protocol — once its flag latches (SIGTERM/SIGINT via
+    the CLI, a drill's record-count trigger, or a chaos ``sigterm``
+    fault), the loop stops admitting (new arrivals resolve to ``rejected``
+    records with the ``draining`` kind, deliberately NOT journaled as
+    terminal so a restart still serves a resubmission), flushes both
+    batchers, completes in-flight work, takes a final journal snapshot
+    and exits with its summary. ``drain_timeout_ms`` bounds the
+    completion phase on the wall clock: past it the loop falls back to
+    snapshot-and-exit (journaled leftovers stay pending for the warm
+    restart; un-journaled ones resolve to draining rejections).
+    ``snapshot_every_ms`` takes a periodic ``journal.compact`` snapshot
+    on the virtual clock, keeping restart cost O(traffic since the last
+    snapshot); a warm restart also resumes the snapshot's degradation
+    level. All three default off and, off, change nothing (the
+    disabled-mode parity contract).
     """
     from ..engine.sampler import lane_select
     from ..utils import progress as progress_mod
@@ -358,6 +397,18 @@ def serve_forever(
     batch_index = 0
     replayed_ids: set = set()
     forced_gate_ids: set = set()
+    # Lifecycle state: the drain flag is polled at cycle boundaries (that
+    # determinism is the point — see serve.lifecycle); an internal
+    # controller stands in when the caller passes none so chaos 'sigterm'
+    # faults always have somewhere to latch.
+    drain_ctl = lifecycle if lifecycle is not None else \
+        lifecycle_mod.DrainController()
+    draining = False
+    drain_wall0 = 0.0
+    drain_timed_out = False
+    last_snapshot_ms = 0.0
+    snapshots_taken = 0
+    restore_degrade_level = 0
 
     # Registry-backed aggregation alongside (never instead of) the JSONL
     # records: the per-request record schema is the stable contract, the
@@ -435,6 +486,21 @@ def serve_forever(
     m_replay = reg.counter(
         "serve_replay_total", "journal replay outcomes by kind",
         labels=("kind",))
+    m_snapshots = reg.counter(
+        "serve_snapshots_total",
+        "journal snapshot+compaction passes by trigger",
+        labels=("trigger",))
+    m_snapshot_folded = reg.histogram(
+        "serve_snapshot_wal_records",
+        "WAL records folded away by each snapshot (the compaction win)")
+    m_gc = reg.counter(
+        "serve_compaction_gc_total",
+        "files removed by compaction/replay GC by kind",
+        labels=("kind",))
+    m_draining = reg.gauge(
+        "serve_draining", "1 while the graceful-drain protocol is active")
+    m_drains = reg.counter(
+        "serve_drains_total", "graceful-drain protocol entries")
 
     def record(status: str, request_id: str, *, release: bool = True,
                journal_write: bool = True, stage_phase: Optional[str] = "mono",
@@ -492,6 +558,7 @@ def serve_forever(
                 "batcher_waiting": {"main": len(batcher),
                                     "phase2": len(batcher2)},
                 "degrade_level": degrade_level,
+                "draining": draining,
                 "batches_dispatched": batch_index,
                 "handoffs": handoffs_total,
                 "counts": dict(counts),
@@ -504,6 +571,50 @@ def serve_forever(
             warm(entries)
         return runner
 
+    def take_chaos(batch_idx, rids):
+        """Chaos consultation shared by every dispatch site. Lifecycle
+        kinds never reach the runner: 'sigterm' latches the drain flag at
+        its keyed dispatch (the batch itself runs normally, like a real
+        SIGTERM landing mid-batch), the kill_* kinds ARM a SimulatedKill
+        that fires at the matching lifecycle point."""
+        if chaos is None:
+            return None
+        fault = chaos.take(batch_idx, rids)
+        if fault is not None and fault.kind in chaos_mod.LIFECYCLE_KINDS:
+            if fault.kind == chaos_mod.SIGTERM:
+                drain_ctl.request(f"chaos:{fault.target}")
+            else:
+                chaos.arm_kill(fault.kind)
+            return None
+        return fault
+
+    def _snapshot_kill_hook():
+        # chaos kill_during_snapshot: dies with the snapshot durably
+        # renamed but the WAL un-rotated — the nastiest real crash window;
+        # the restart must fold snapshot + overlapping WAL idempotently.
+        if chaos is not None and \
+                chaos.take_kill(chaos_mod.KILL_DURING_SNAPSHOT):
+            raise chaos_mod.SimulatedKill("chaos kill_during_snapshot")
+
+    def take_snapshot(trigger: str) -> dict:
+        """One journal.compact pass + its bookkeeping (periodic + drain)."""
+        nonlocal snapshots_taken
+        with span("serve.snapshot", trigger=trigger):
+            info = journal.compact(extra={"degrade_level": degrade_level},
+                                   on_durable=_snapshot_kill_hook)
+        snapshots_taken += 1
+        m_snapshots.labels(trigger=trigger).inc()
+        m_snapshot_folded.observe(float(info["wal_records_folded"]))
+        if info["orphans_swept"]:
+            m_gc.labels(kind="spill_orphan").inc(info["orphans_swept"])
+        if journal is not None:
+            journal.event("snapshot", seq=info["seq"], trigger=trigger,
+                          vnow_ms=round(vnow, 3))
+        if flight is not None:
+            flight.loop_event("snapshot", vnow, trigger=trigger,
+                              seq=info["seq"])
+        return info
+
     # ------------------------------------------------------------------
     # Journal replay: reconstruct the queue from non-terminal WAL entries
     # (served exactly once; arrival restarts on this incarnation's clock)
@@ -515,12 +626,31 @@ def serve_forever(
     if journal is not None:
         rs = journal.replay_state
         replay_skip = set(rs.terminal) | set(rs.pending_ids)
-        if rs.pending or rs.terminal or rs.skipped_corrupt:
+        restore_degrade_level = rs.degrade_level if degrade is not None \
+            else 0
+        if rs.orphans_swept:
+            m_gc.labels(kind="spill_orphan").inc(rs.orphans_swept)
+        if rs.segments_swept:
+            m_gc.labels(kind="segment").inc(rs.segments_swept)
+        if rs.pending or rs.terminal or rs.skipped_corrupt \
+                or rs.snapshot_corrupt or rs.orphans_swept:
             replay_info = {"pending": len(rs.pending),
                            "terminal": len(rs.terminal),
                            "skipped_corrupt": rs.skipped_corrupt,
                            "duplicate_terminals": rs.duplicate_terminals,
                            "deduped": 0}
+            if rs.snapshot_loaded:
+                # The warm-restart receipt: how much history the snapshot
+                # absorbed vs the tail this fold actually read.
+                replay_info["snapshot"] = {
+                    "seq": rs.snapshot_seq,
+                    "wal_tail_records": rs.wal_records,
+                    "folded_records": rs.folded_records}
+            if rs.snapshot_corrupt:
+                replay_info["snapshot_corrupt"] = True
+                m_replay.labels(kind="snapshot_corrupt").inc()
+            if rs.orphans_swept:
+                replay_info["orphans_swept"] = rs.orphans_swept
             if rs.skipped_corrupt:
                 m_replay.labels(kind="corrupt_skipped").inc(
                     rs.skipped_corrupt)
@@ -773,8 +903,7 @@ def serve_forever(
                             pool="mono")
         attempt = 0
         while True:
-            fault = (chaos.take(this_batch, [e.request_id for e in live])
-                     if chaos is not None else None)
+            fault = take_chaos(this_batch, [e.request_id for e in live])
             t0 = timer()
             try:
                 span_name = "serve.batch" if attempt == 0 else "serve.retry"
@@ -914,8 +1043,7 @@ def serve_forever(
                 # latency the flight record must attribute.
                 flight.wait(e.request_id, "requeue_wait", dispatch_ms,
                             pool="mono", isolated=True)
-            fault = (chaos.take(batch_index, [e.request_id])
-                     if chaos is not None else None)
+            fault = take_chaos(batch_index, [e.request_id])
             try:
                 t0 = timer()
                 with _trace_attach([e]), \
@@ -1074,8 +1202,7 @@ def serve_forever(
                             pool="phase1")
         attempt = 0
         while True:
-            fault = (chaos.take(this_batch, [e.request_id for e in live])
-                     if chaos is not None else None)
+            fault = take_chaos(this_batch, [e.request_id for e in live])
             t0 = timer()
             try:
                 span_name = "serve.batch" if attempt == 0 else "serve.retry"
@@ -1175,8 +1302,7 @@ def serve_forever(
             if flight is not None:
                 flight.wait(e.request_id, "requeue_wait", dispatch_ms,
                             pool="phase1", isolated=True)
-            fault = (chaos.take(batch_index, [e.request_id])
-                     if chaos is not None else None)
+            fault = take_chaos(batch_index, [e.request_id])
             try:
                 t0 = timer()
                 with _trace_attach([e]), \
@@ -1311,8 +1437,7 @@ def serve_forever(
                             pool="phase2")
         attempt = 0
         while True:
-            fault = (chaos.take(this_batch, [e.request_id for e in live])
-                     if chaos is not None else None)
+            fault = take_chaos(this_batch, [e.request_id for e in live])
             t0 = timer()
             try:
                 span_name = "serve.batch" if attempt == 0 else "serve.retry"
@@ -1434,8 +1559,7 @@ def serve_forever(
             if flight is not None:
                 flight.wait(e.request_id, "requeue_wait", dispatch_ms,
                             pool="phase2", isolated=True)
-            fault = (chaos.take(batch_index, [e.request_id])
-                     if chaos is not None else None)
+            fault = take_chaos(batch_index, [e.request_id])
             try:
                 t0 = timer()
                 with _trace_attach([e]), \
@@ -1560,7 +1684,30 @@ def serve_forever(
             _shrunken_bucket(phase2_max_batch, degrade.min_bucket)
             if shrink else phase2_max_batch)
 
+    if restore_degrade_level:
+        # Warm restart: resume the snapshot's degradation level instead of
+        # re-learning the pressure from scratch (transitions from here on
+        # are journaled/counted as usual; recovery hysteresis applies).
+        degrade_level = min(3, max(0, int(restore_degrade_level)))
+        m_degrade_level.set(degrade_level)
+        _apply_degrade_level()
+
     while True:
+        if drain_ctl.requested and not draining:
+            # Graceful drain latches here, at a cycle boundary — the
+            # deterministic check point that makes drill drains replay
+            # identically. From now on: no admissions, no waiting on
+            # future arrivals; in-flight work completes (or the wall-clock
+            # budget expires), then snapshot + summary + exit.
+            draining = True
+            drain_wall0 = timer()
+            m_draining.set(1)
+            m_drains.inc()
+            if journal is not None:
+                journal.event("drain", reason=drain_ctl.reason,
+                              vnow_ms=round(vnow, 3))
+            if flight is not None:
+                flight.loop_event("drain", vnow, reason=drain_ctl.reason)
         # 1. Admit everything that has arrived by now.
         while trace.peek() is not None and \
                 getattr(trace.peek(), "arrival_ms", vnow) <= vnow:
@@ -1574,6 +1721,19 @@ def serve_forever(
                 m_replay.labels(kind="deduped").inc()
                 if replay_info is not None:
                     replay_info["deduped"] += 1
+                continue
+            if draining:
+                # Not journaled as terminal (journal_write=False): a
+                # draining rejection is backpressure, not a resolution —
+                # the restarted server must still serve a resubmission of
+                # this id (the rolling-restart drill's re-fed trace relies
+                # on exactly that).
+                m_rejects.labels(kind="draining").inc()
+                yield record(
+                    "rejected", item.request_id, release=False,
+                    journal_write=False, arrival_ms=item.arrival_ms,
+                    reason=f"server draining ({drain_ctl.reason}); "
+                           f"resubmit after restart")
                 continue
             forced_gate = degrade_level >= 1 and item.gate is None
             if forced_gate:
@@ -1636,15 +1796,19 @@ def serve_forever(
         if not batches and not batches2:
             if journal is not None:
                 journal.sync()  # going idle: everything admitted is durable
-            events = [t for t in (trace.next_arrival_ms,
-                                  batcher.next_flush_ms(),
-                                  batcher2.next_flush_ms())
-                      if t is not None]
+            # Draining: never wait on future arrivals or bucket age-outs —
+            # flush everything now and exit once the pipeline is empty.
+            events = [] if draining else [
+                t for t in (trace.next_arrival_ms,
+                            batcher.next_flush_ms(),
+                            batcher2.next_flush_ms())
+                if t is not None]
             if events:
                 vnow = max(vnow, min(events))
                 continue
-            # Trace done: drain both tails (hand-offs produced by the
-            # phase-1 tail re-enter via the next loop iteration).
+            # Trace done (or draining): drain both tails (hand-offs
+            # produced by the phase-1 tail re-enter via the next loop
+            # iteration).
             batches2 = batcher2.flush_all(vnow)
             batches = batcher.flush_all(vnow)
             if not batches and not batches2:
@@ -1652,10 +1816,47 @@ def serve_forever(
         ordered = ([("phase2", b) for b in batches2]
                    + [("phase1", b) for b in batches])
         for bi, (pool, batch) in enumerate(ordered):
+            if draining and drain_timeout_ms is not None and \
+                    (timer() - drain_wall0) * 1000.0 > drain_timeout_ms:
+                # Drain budget exhausted: fall back to snapshot-and-exit.
+                # Journaled leftovers stay *pending* — no terminal record,
+                # so the warm restart serves them exactly once (their
+                # hand-off carries were already spilled at hand-off time);
+                # without a journal there is no restart, so they resolve
+                # to explicit draining rejections, never a silent drop.
+                drain_timed_out = True
+                leftover = [e for _, b in ordered[bi:] for e in b.entries]
+                leftover += [e for b in batcher.flush_all(vnow)
+                             for e in b.entries]
+                leftover += [e for b in batcher2.flush_all(vnow)
+                             for e in b.entries]
+                leftover += queue.drain()
+                if journal is not None:
+                    journal.event("drain_timeout", pending=len(leftover),
+                                  vnow_ms=round(vnow, 3))
+                else:
+                    for e in leftover:
+                        m_rejects.labels(kind="draining").inc()
+                        yield record(
+                            "rejected", e.request_id,
+                            arrival_ms=e.arrival_ms,
+                            reason=f"drain timeout "
+                                   f"({drain_timeout_ms:.0f}ms) before "
+                                   f"dispatch; no journal to resume from")
+                break
             if pool == "phase2":
                 yield from dispatch_phase2(batch)
             else:
                 yield from dispatch(batch)
+            if draining and chaos is not None and \
+                    chaos.take_kill(chaos_mod.KILL_DURING_DRAIN):
+                # Simulated death mid-drain: batch-boundary durability
+                # first (matching the healthy loop's fsync point), then
+                # die without records or a summary — the restart's
+                # exactly-once contract is what the drill asserts.
+                if journal is not None:
+                    journal.sync()
+                raise chaos_mod.SimulatedKill("chaos kill_during_drain")
             if fatal_reason[0] is not None:
                 # Fatal fault: drain cleanly — terminal records for every
                 # outstanding request, then the summary. Nothing is left
@@ -1694,8 +1895,60 @@ def serve_forever(
                 break
         if journal is not None:
             journal.sync()  # batch boundary: the fsync point
+            if snapshot_every_ms is not None and not draining and \
+                    vnow - last_snapshot_ms >= snapshot_every_ms:
+                # Periodic snapshot+compaction on the virtual clock, at
+                # the fsync point (everything it folds is already
+                # durable). Skipped while draining — the drain takes its
+                # own final snapshot.
+                take_snapshot("periodic")
+                last_snapshot_ms = vnow
         if fatal_reason[0] is not None:
             break
+
+    drain_info = None
+    if draining:
+        # The trace tail: requests that had not yet *arrived* when the
+        # drain cut virtual time still resolve explicitly (the fatal-drain
+        # discipline — never a silent drop): draining rejections,
+        # un-journaled, so a restart's re-fed trace (or the client's
+        # resubmission) still serves them.
+        while trace.peek() is not None:
+            item = trace.pop()
+            if isinstance(item, Cancel):
+                continue
+            if item.request_id in replay_skip:
+                m_replay.labels(kind="deduped").inc()
+                if replay_info is not None:
+                    replay_info["deduped"] += 1
+                continue
+            m_rejects.labels(kind="draining").inc()
+            yield record(
+                "rejected", item.request_id, release=False,
+                journal_write=False, arrival_ms=item.arrival_ms,
+                reason=f"server draining ({drain_ctl.reason}); "
+                       f"resubmit after restart")
+        if chaos is not None and \
+                chaos.take_kill(chaos_mod.KILL_DURING_DRAIN):
+            # Still-armed kill (the drain had no dispatches left to ride):
+            # die at the drain's nastiest remaining window — terminals
+            # flushed, final snapshot not yet taken.
+            if journal is not None:
+                journal.sync()
+            raise chaos_mod.SimulatedKill("chaos kill_during_drain")
+        m_draining.set(0)
+        drain_info = {"reason": drain_ctl.reason,
+                      "pending": queue.outstanding}
+        if drain_timed_out:
+            drain_info["timed_out"] = True
+        if journal is not None:
+            info = take_snapshot("drain")
+            drain_info["snapshot"] = {
+                "seq": info["seq"], "pending": info["pending"],
+                "wal_records_folded": info["wal_records_folded"]}
+        if flight is not None:
+            flight.loop_event("drained", vnow,
+                              pending=drain_info["pending"])
 
     n_batches = len(occupancies)
     lat_sorted = sorted(latencies)
@@ -1738,6 +1991,12 @@ def serve_forever(
         summary["replay"] = replay_info
     if fatal_reason[0] is not None:
         summary["fatal"] = fatal_reason[0]
+    if snapshots_taken:
+        # Present only when a snapshot actually ran, so summaries of
+        # lifecycle-less runs stay byte-identical (disabled-mode parity).
+        summary["snapshots"] = snapshots_taken
+    if drain_info is not None:
+        summary["drain"] = drain_info
     if journal is not None:
         journal.sync()
     yield summary

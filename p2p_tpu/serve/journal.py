@@ -29,6 +29,32 @@ to the caller, so a crash exactly between compute and emission re-runs that
 request (at-least-once compute); a crash after the terminal line treats it
 as delivered (outputs are not stored in the WAL — images are the caller's
 to persist). Request *state* is exactly-once; see docs/SERVING.md.
+
+**Snapshot + compaction** (:meth:`Journal.compact`): an append-only WAL
+grows without bound — replay cost and disk footprint are O(process
+history). A *snapshot* captures the replay-folded state — the pending
+request dicts (in admission order), the live hand-off records (carry spill
+path + pinned spec + optional trace context), the terminal-id dedupe map,
+and the loop's degradation level — as an atomic tmp+rename+fsync JSON at
+``<wal>.snapshot``, after which the WAL *rotates* (the folded segment is
+garbage-collected) and orphaned carry spills (``*.npz.tmp`` from a crash
+mid-spill, unreferenced ``*.npz`` from a lost terminal discard) are swept.
+Restart cost becomes O(traffic since the last snapshot): :func:`replay`
+seeds its fold from the snapshot and only reads the WAL *tail*. Every
+crash window is safe by construction:
+
+- crash mid-snapshot-write → only the ``.tmp`` is torn; the visible
+  snapshot is the previous good one (or absent) and the WAL is untouched;
+- crash between the snapshot rename and the WAL rotation → the snapshot
+  and the WAL *overlap*; folding is idempotent (first admission wins,
+  duplicate terminals collapse), so replaying both is still exact;
+- crash between rotation and old-segment removal → the stale ``.old``
+  segment's content is a subset of the snapshot (rotation only ever runs
+  after the snapshot fsync) and is swept on the next replay;
+- a snapshot that is nevertheless corrupt (operator damage) is ignored
+  with a counter and replay falls back to full-WAL folding — correct
+  whenever no rotation has discarded history, which is the only state the
+  journal's own writer can produce alongside an unreadable snapshot.
 """
 
 from __future__ import annotations
@@ -36,13 +62,19 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Dict, List
+from typing import Callable, Dict, List, Optional
 
 ADMITTED = "admitted"
 DISPATCHED = "dispatched"
 HANDOFF = "handoff"
 TERMINAL = "terminal"
 EVENT = "event"
+
+#: Snapshot sidecar (``<wal>.snapshot``) and the rotated-away segment
+#: (``<wal>.old``, transient: exists only inside compact()'s crash window).
+SNAPSHOT_SUFFIX = ".snapshot"
+OLD_SEGMENT_SUFFIX = ".old"
+SNAPSHOT_VERSION = 1
 
 #: Statuses that end a request's life; anything else in a ``terminal``
 #: record is skipped as corrupt (a half-written status string).
@@ -61,67 +93,204 @@ class ReplayState:
     handoffs: Dict[str, dict] = dataclasses.field(default_factory=dict)
     skipped_corrupt: int = 0
     duplicate_terminals: int = 0
+    #: Degradation level the previous incarnation was running at (from the
+    #: snapshot and any later journaled degrade/restore events) — a warm
+    #: restart resumes it instead of re-learning the pressure from scratch.
+    degrade_level: int = 0
+    #: Snapshot fold facts: whether a snapshot seeded this state, whether a
+    #: present-but-unreadable snapshot was ignored, and its sequence number.
+    snapshot_loaded: bool = False
+    snapshot_corrupt: int = 0
+    snapshot_seq: int = 0
+    #: WAL-tail records read by THIS fold (every non-blank line attempted),
+    #: and the cumulative history (snapshot's folded count + the tail) —
+    #: ``wal_records < folded_records`` is the compaction win, asserted by
+    #: the rolling-restart drill rather than merely measured.
+    wal_records: int = 0
+    folded_records: int = 0
+    #: Hygiene sweep counters (``sweep=True``): orphaned carry spills
+    #: (``*.npz.tmp`` + unreferenced ``*.npz``) and stale rotated segments
+    #: removed during this fold.
+    orphans_swept: int = 0
+    segments_swept: int = 0
 
     @property
     def pending_ids(self):
         return [d["request_id"] for d in self.pending]
 
 
-def replay(path: str) -> ReplayState:
-    """Fold the WAL at ``path`` into a :class:`ReplayState`. Missing file =
-    empty state. Corrupt lines (torn tail, garbage bytes, wrong shapes) are
-    skipped and counted — the reader must survive anything a crash can
-    leave behind."""
+def _load_snapshot(spath: str):
+    """Read + validate the snapshot sidecar. Returns ``(snap, corrupt)``:
+    ``(dict, False)`` for a good snapshot, ``(None, False)`` when absent,
+    ``(None, True)`` when present but unreadable/invalid — the caller
+    falls back to full-WAL folding with a counter, never a crash."""
+    if not os.path.exists(spath):
+        return None, False
+    try:
+        with open(spath, "r", encoding="utf-8", errors="replace") as f:
+            snap = json.load(f)
+        if not isinstance(snap, dict) or \
+                snap.get("version") != SNAPSHOT_VERSION:
+            raise ValueError("bad version")
+        if not (isinstance(snap.get("pending"), list)
+                and all(isinstance(d, dict) and d.get("request_id")
+                        for d in snap["pending"])):
+            raise ValueError("bad pending")
+        if not (isinstance(snap.get("terminal"), dict)
+                and all(v in TERMINAL_STATUSES
+                        for v in snap["terminal"].values())):
+            raise ValueError("bad terminal")
+        if not (isinstance(snap.get("handoffs"), dict)
+                and all(isinstance(h, dict) and h.get("carry_path")
+                        for h in snap["handoffs"].values())):
+            raise ValueError("bad handoffs")
+        int(snap.get("seq", 0))
+        int(snap.get("degrade_level", 0))
+        int(snap.get("folded_records", 0))
+        return snap, False
+    except (OSError, ValueError, TypeError):
+        return None, True
+
+
+def _sweep(path: str, state: ReplayState, stale_old: bool) -> None:
+    """Hygiene half of a fold: drop the stale rotated segment (its content
+    is a subset of the snapshot — rotation only runs after the snapshot
+    fsync), a leftover snapshot ``.tmp`` (crash mid-write), and orphaned
+    carry spills: every ``*.npz.tmp`` (a crash between ``open(tmp)`` and
+    ``os.replace``) plus every ``*.npz`` no live hand-off references (a
+    crash between the terminal record and its spill discard). Counted on
+    ``state``; all removals best-effort."""
+    if stale_old and state.snapshot_loaded:
+        # Only GC the segment when a snapshot subsumes it. The
+        # operator-damage case (segment, no snapshot) keeps the segment on
+        # disk: it is the sole durable copy of its pending admissions.
+        try:
+            os.remove(path + OLD_SEGMENT_SUFFIX)
+            state.segments_swept += 1
+        except OSError:
+            pass
+    try:
+        os.remove(path + SNAPSHOT_SUFFIX + ".tmp")
+        state.orphans_swept += 1
+    except OSError:
+        pass
+    carry_dir = path + ".carry"
+    if not os.path.isdir(carry_dir):
+        return
+    # A spill is referenced while its hand-off record is retained — every
+    # NON-terminal id, the same rule compact() snapshots by (a torn WAL
+    # can order a hand-off before its readable admission; sweeping the
+    # spill while keeping the record would defeat the retention).
+    referenced = {os.path.abspath(rec["carry_path"])
+                  for rid, rec in state.handoffs.items()
+                  if rid not in state.terminal}
+    for name in sorted(os.listdir(carry_dir)):
+        full = os.path.join(carry_dir, name)
+        if name.endswith(".tmp") or \
+                (name.endswith(".npz")
+                 and os.path.abspath(full) not in referenced):
+            try:
+                os.remove(full)
+                state.orphans_swept += 1
+            except OSError:
+                pass
+
+
+def replay(path: str, *, sweep: bool = True) -> ReplayState:
+    """Fold the snapshot (if any) plus the WAL at ``path`` into a
+    :class:`ReplayState`. Missing file(s) = empty state. Corrupt lines
+    (torn tail, garbage bytes, wrong shapes) are skipped and counted — the
+    reader must survive anything a crash can leave behind. A corrupt
+    snapshot is ignored the same way (``snapshot_corrupt``), falling back
+    to full-WAL folding. ``sweep`` (the default) also garbage-collects
+    orphaned carry spills and stale rotated segments — pass ``False`` for
+    a read-only fold (e.g. :meth:`Journal.compact`'s own)."""
     state = ReplayState()
-    if not os.path.exists(path):
-        return state
     admitted: Dict[str, dict] = {}
     order: List[str] = []
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                state.skipped_corrupt += 1
-                continue
-            if not isinstance(rec, dict):
-                state.skipped_corrupt += 1
-                continue
-            kind = rec.get("type")
-            if kind == ADMITTED:
-                req = rec.get("request")
-                rid = isinstance(req, dict) and req.get("request_id")
-                if not rid:
+
+    snap, corrupt = _load_snapshot(path + SNAPSHOT_SUFFIX)
+    if corrupt:
+        state.snapshot_corrupt = 1
+    if snap is not None:
+        state.snapshot_loaded = True
+        state.snapshot_seq = int(snap.get("seq", 0))
+        state.degrade_level = int(snap.get("degrade_level", 0))
+        state.folded_records = int(snap.get("folded_records", 0))
+        for req in snap["pending"]:
+            rid = req["request_id"]
+            if rid not in admitted:
+                admitted[rid] = req
+                order.append(rid)
+        state.terminal.update(snap["terminal"])
+        state.handoffs.update(snap["handoffs"])
+
+    def fold_file(p: str) -> None:
+        with open(p, "r", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                state.wal_records += 1
+                try:
+                    rec = json.loads(line)
+                except ValueError:
                     state.skipped_corrupt += 1
                     continue
-                if rid not in admitted:  # first admission wins
-                    admitted[rid] = req
-                    order.append(rid)
-            elif kind == TERMINAL:
-                rid = rec.get("id")
-                status = rec.get("status")
-                if not rid or status not in TERMINAL_STATUSES:
+                if not isinstance(rec, dict):
                     state.skipped_corrupt += 1
                     continue
-                if rid in state.terminal:
-                    state.duplicate_terminals += 1
+                kind = rec.get("type")
+                if kind == ADMITTED:
+                    req = rec.get("request")
+                    rid = isinstance(req, dict) and req.get("request_id")
+                    if not rid:
+                        state.skipped_corrupt += 1
+                        continue
+                    if rid not in admitted:  # first admission wins
+                        admitted[rid] = req
+                        order.append(rid)
+                elif kind == TERMINAL:
+                    rid = rec.get("id")
+                    status = rec.get("status")
+                    if not rid or status not in TERMINAL_STATUSES:
+                        state.skipped_corrupt += 1
+                        continue
+                    if rid in state.terminal:
+                        state.duplicate_terminals += 1
+                    else:
+                        state.terminal[rid] = status
+                elif kind == HANDOFF:
+                    rid = rec.get("id")
+                    if not rid or not rec.get("carry_path"):
+                        state.skipped_corrupt += 1
+                        continue
+                    state.handoffs[rid] = rec  # last hand-off wins (retries)
+                elif kind in (DISPATCHED, EVENT):
+                    # Informational for replay — except the degradation
+                    # transitions, which the warm restart resumes.
+                    if kind == EVENT and rec.get("kind") in ("degrade",
+                                                             "restore"):
+                        try:
+                            state.degrade_level = int(rec.get("level"))
+                        except (TypeError, ValueError):
+                            pass
                 else:
-                    state.terminal[rid] = status
-            elif kind == HANDOFF:
-                rid = rec.get("id")
-                if not rid or not rec.get("carry_path"):
                     state.skipped_corrupt += 1
-                    continue
-                state.handoffs[rid] = rec  # last hand-off wins (retries)
-            elif kind in (DISPATCHED, EVENT):
-                pass  # informational; replay keys off admitted/terminal
-            else:
-                state.skipped_corrupt += 1
+
+    stale_old = os.path.exists(path + OLD_SEGMENT_SUFFIX)
+    if stale_old and snap is None:
+        # A rotated segment with no readable snapshot can only come from
+        # operator damage (the writer rotates strictly after the snapshot
+        # fsync): fold it best-effort before the tail.
+        fold_file(path + OLD_SEGMENT_SUFFIX)
+    if os.path.exists(path):
+        fold_file(path)
+    state.folded_records += state.wal_records
     state.pending = [admitted[rid] for rid in order
                      if rid not in state.terminal]
+    if sweep:
+        _sweep(path, state, stale_old)
     return state
 
 
@@ -206,6 +375,81 @@ class Journal:
         self._f.flush()
         os.fsync(self._f.fileno())
         self._dirty = False
+
+    def compact(self, extra: Optional[dict] = None,
+                on_durable: Optional[Callable[[], None]] = None) -> dict:
+        """Snapshot the replay-folded state, then rotate + GC the WAL.
+
+        1. sync the WAL, fold it (plus any previous snapshot) read-only;
+        2. write the new snapshot to ``<wal>.snapshot.tmp``, fsync, rename
+           over ``<wal>.snapshot``, fsync the directory — atomic: a crash
+           leaves either the previous snapshot or the new one, never a
+           torn file;
+        3. (``on_durable`` fires here — the chaos ``kill_during_snapshot``
+           hook: the snapshot is durable but the WAL has not rotated, so a
+           restart must fold the two idempotently);
+        4. rotate: the WAL moves aside and a fresh empty segment opens —
+           replay cost is now O(traffic since this snapshot);
+        5. GC: the rotated segment and orphaned carry spills are removed.
+
+        ``extra`` merges engine-side state the WAL itself cannot fold
+        (currently ``degrade_level``). Returns the compaction facts the
+        engine's summary/metrics report."""
+        self.sync()
+        state = replay(self.path, sweep=False)
+        # Keep every non-terminal hand-off, not just currently-pending
+        # ones: a torn WAL can order a hand-off before its admission is
+        # readable, and dropping it here would lose the resume if the
+        # admission only lands in the post-snapshot tail.
+        handoffs = {rid: rec for rid, rec in state.handoffs.items()
+                    if rid not in state.terminal}
+        snap = {"version": SNAPSHOT_VERSION,
+                "seq": state.snapshot_seq + 1,
+                "pending": state.pending,
+                "handoffs": handoffs,
+                "terminal": state.terminal,
+                "degrade_level": int((extra or {}).get(
+                    "degrade_level", state.degrade_level)),
+                "folded_records": state.folded_records}
+        spath = self.path + SNAPSHOT_SUFFIX
+        tmp = spath + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(snap, f)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, spath)
+        dfd = os.open(os.path.dirname(spath) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        if on_durable is not None:
+            on_durable()
+        # Rotate: everything in the current segment is folded into the
+        # durable snapshot, so the segment is garbage. A crash anywhere in
+        # here leaves a state replay() folds exactly (idempotent overlap /
+        # stale-segment sweep — see the module docstring).
+        self._f.close()
+        old = self.path + OLD_SEGMENT_SUFFIX
+        os.replace(self.path, old)
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._dirty = False
+        try:
+            os.remove(old)
+        except OSError:
+            pass
+        gc_state = ReplayState(pending=state.pending, handoffs=handoffs,
+                               snapshot_loaded=True)
+        _sweep(self.path, gc_state, stale_old=False)
+        return {"seq": snap["seq"],
+                "pending": len(state.pending),
+                "terminal": len(state.terminal),
+                "handoffs": len(handoffs),
+                "wal_records_folded": state.wal_records,
+                "folded_records": state.folded_records,
+                "orphans_swept": gc_state.orphans_swept,
+                "bytes": os.path.getsize(spath)}
 
     def close(self) -> None:
         try:

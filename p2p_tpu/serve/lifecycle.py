@@ -1,0 +1,94 @@
+"""Engine lifecycle: graceful drain, signal handling, warm restart.
+
+Before this layer, the serve loop had exactly one way to stop: die. Every
+shutdown was a simulated crash — the journal's replay made that *safe*
+(exactly-once state), but never *orderly*: in-flight batches were thrown
+away, the summary was lost, and the next incarnation paid a full-WAL
+replay. This module is the orderly half of the durability story
+(``journal.compact`` is the other): a long-running server can now
+
+- **drain** (``DrainController``): stop admitting — new arrivals resolve
+  to ``rejected`` records with the ``draining`` kind, deliberately *not*
+  journaled as terminal so a resubmission to the restarted server (or the
+  re-fed trace of a rolling-restart drill) still serves them — flush both
+  batchers, complete in-flight work (phase-2 hand-offs included), take a
+  final snapshot, emit the summary, and exit 0;
+- bound the drain (``serve_forever(drain_timeout_ms=)``): past the wall-
+  clock budget the loop falls back to snapshot-and-exit — journaled
+  leftovers stay *pending* (no terminal record, so the warm restart
+  serves them exactly once; their hand-off carries were already spilled),
+  un-journaled leftovers resolve to explicit draining rejections;
+- **warm-restart**: ``--journal`` resumes from the snapshot + WAL tail
+  (O(traffic since the last snapshot), not O(process history)), restoring
+  the pending queue, the live phase-2 carries, the terminal dedupe set
+  and the degradation level.
+
+The controller is deliberately dumb — one latched flag the engine polls at
+cycle boundaries — because that is what makes drains *deterministic* under
+the virtual clock: a drill can request a drain at an exact record count
+and replay the identical control flow every run. :func:`signal_drain`
+wires the same flag to SIGTERM/SIGINT for the CLI: first signal = request
+a graceful drain; a second = ``KeyboardInterrupt`` (force quit — the
+journal's crash contract takes over, which is exactly what it is for).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import signal
+import threading
+from typing import Iterator, Optional, Sequence
+
+
+class DrainController:
+    """A latched drain request the engine polls at cycle boundaries.
+
+    ``request()`` is idempotent (the first reason wins) and safe to call
+    from a signal handler, another thread, or mid-iteration from the code
+    consuming the record stream — it only ever sets a flag; the engine
+    does all the work at its next deterministic check point."""
+
+    def __init__(self):
+        self.requested = False
+        self.reason: Optional[str] = None
+
+    def request(self, reason: str = "request") -> None:
+        if not self.requested:
+            self.reason = reason
+            self.requested = True
+
+
+@contextlib.contextmanager
+def signal_drain(controller: DrainController,
+                 signums: Sequence[int] = (signal.SIGTERM, signal.SIGINT),
+                 ) -> Iterator[DrainController]:
+    """Route SIGTERM/SIGINT into ``controller`` while the body runs.
+
+    First signal: request a graceful drain (the loop finishes in-flight
+    work, snapshots, emits the summary, exits 0). Any further signal:
+    raise ``KeyboardInterrupt`` — the operator wants out *now*; the
+    journal's crash-replay contract covers what the force-quit abandons.
+    Handlers are restored on exit. Off the main thread (where CPython
+    forbids ``signal.signal``) this is a documented no-op wrapper."""
+    if threading.current_thread() is not threading.main_thread():
+        yield controller
+        return
+    seen = [0]
+
+    def _handler(signum, frame):
+        seen[0] += 1
+        if seen[0] == 1:
+            try:
+                name = signal.Signals(signum).name
+            except ValueError:
+                name = f"signal {signum}"
+            controller.request(name)
+        else:
+            raise KeyboardInterrupt(f"second {signum}: force quit")
+
+    prev = {s: signal.signal(s, _handler) for s in signums}
+    try:
+        yield controller
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)

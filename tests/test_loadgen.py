@@ -118,3 +118,57 @@ def test_cli_writes_jsonl(tmp_path):
     assert len(lines) == 6
     assert lines == lg.generate_trace(6, mode="poisson", rate_per_s=50.0,
                                       seed=3, steps=4)
+
+
+def test_stream_prefix_is_seed_stable(tmp_path):
+    """ISSUE 9 satellite pin: the streaming long-trace mode draws its RNG
+    per request, so the first K requests are byte-identical to the finite
+    --n K trace — whatever the horizon (or no horizon at all)."""
+    import itertools
+
+    lg = _loadgen()
+    finite = lg.generate_trace(24, mode="poisson", rate_per_s=30.0, seed=9,
+                               steps=4)
+    prefix = list(itertools.islice(
+        lg.generate_stream(None, mode="poisson", rate_per_s=30.0, seed=9,
+                           steps=4), 24))
+    assert prefix == finite
+    # A duration-bounded stream is a prefix of the unbounded one.
+    horizon = lg.generate_trace(24, mode="poisson", rate_per_s=30.0,
+                                seed=9, steps=4)[11]["arrival_ms"]
+    bounded = list(lg.generate_stream(horizon, mode="poisson",
+                                      rate_per_s=30.0, seed=9, steps=4))
+    assert bounded == finite[:len(bounded)]
+    assert len(bounded) >= 12
+    assert all(r["arrival_ms"] <= horizon for r in bounded)
+    # Gate-mix and burst mode ride the same per-request draw order.
+    mix = lg.parse_gate_mix("0.5:1,off:1")
+    assert list(itertools.islice(
+        lg.generate_stream(None, seed=5, steps=4, gate_mix=mix), 16)) == \
+        lg.generate_trace(16, seed=5, steps=4, gate_mix=mix)
+    assert list(itertools.islice(
+        lg.generate_stream(None, mode="burst", seed=2, steps=4,
+                           burst_size=4), 12)) == \
+        lg.generate_trace(12, mode="burst", seed=2, steps=4, burst_size=4)
+
+
+def test_stream_with_cancels_matches_finite_form():
+    lg = _loadgen()
+    trace = lg.generate_trace(20, seed=7, steps=4)
+    assert list(lg.stream_with_cancels(iter(trace), 7, 0.3)) == \
+        lg.with_cancels(trace, 7, 0.3)
+
+
+def test_cli_duration_ms_streams_and_rejects_fault_rate(tmp_path):
+    lg = _loadgen()
+    out = tmp_path / "soak.jsonl"
+    assert lg.main(["--duration-ms", "2000", "--rate", "20", "--seed", "3",
+                    "--steps", "4", "--out", str(out)]) == 0
+    lines = [json.loads(l) for l in open(out)]
+    assert lines, "the horizon produced requests"
+    assert all(r["arrival_ms"] <= 2000 for r in lines)
+    assert lines == lg.generate_trace(len(lines), rate_per_s=20.0, seed=3,
+                                      steps=4)
+    with pytest.raises(SystemExit):
+        lg.main(["--duration-ms", "2000", "--fault-rate", "0.5",
+                 "--out", str(out)])

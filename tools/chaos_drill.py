@@ -20,6 +20,15 @@ keeps only what was flushed), then the loop restarts against the same
 both runs serves every request exactly once, with no completed request
 re-running.
 
+``--rolling N`` adds the lifecycle leg (ISSUE 9): N graceful
+drain/restart cycles mid-trace — each drain snapshots + compacts the
+journal, each restart warm-resumes from snapshot + WAL tail — must yield
+exactly-once terminals, ok-outputs bitwise-identical to the uninterrupted
+run, snapshot+tail folds byte-equivalent to the never-compacted shadow
+WAL, and restarts that replay *strictly fewer* records than the full
+history (asserted, not just measured). ``--kill-mid-drain`` arms a chaos
+``kill_during_drain`` in the middle cycle.
+
 The whole drill is virtual-clock deterministic on the random-init tiny
 pipeline (no checkpoints), so it doubles as the ``fault_drill`` check in
 ``tools/quality_gate.py`` and the ``resilience`` block in ``bench.py``.
@@ -160,6 +169,23 @@ def check_bitwise_vs_clean(clean_by_id: dict, faulted_by_id: dict) -> int:
     return compared
 
 
+def _prewarm_reps(pipe, trace):
+    """One representative request per distinct compile key — the
+    bucket-pinning compile-ahead list (see the comment in run_drill)."""
+    from p2p_tpu.serve import Request, prepare
+
+    reps, seen = [], set()
+    for d in trace:
+        if "request_id" not in d:
+            continue
+        r = Request.from_dict(d)
+        key = prepare(r, pipe).compile_key
+        if key not in seen:
+            seen.add(key)
+            reps.append(r)
+    return reps
+
+
 def run_drill(pipe, trace, plan, *, watchdog_ms=None, journal_path=None,
               crash_after=None, serve_kw=None, warmup: bool = False) -> dict:
     """Run the (clean, faulted[, crash-replay]) drill; raise
@@ -169,7 +195,7 @@ def run_drill(pipe, trace, plan, *, watchdog_ms=None, journal_path=None,
     ``warmup=True`` runs the clean trace once unmeasured first, so the
     measured runs both hit warm compile caches and the reported p95 delta
     is retry/backoff cost, not compile noise."""
-    from p2p_tpu.serve import Request, prepare, serve_forever
+    from p2p_tpu.serve import serve_forever
 
     # phase2_max_batch pinned to max_batch: the drill's bitwise invariant
     # compares clean vs faulted runs whose batch *composition* may differ
@@ -191,16 +217,7 @@ def run_drill(pipe, trace, plan, *, watchdog_ms=None, journal_path=None,
     # are composition-independent — and it mirrors what the serve CLI
     # does by default (compile-ahead).
     if "prewarm" not in kw:
-        reps, seen = [], set()
-        for d in trace:
-            if "request_id" not in d:
-                continue
-            r = Request.from_dict(d)
-            key = prepare(r, pipe).compile_key
-            if key not in seen:
-                seen.add(key)
-                reps.append(r)
-        kw["prewarm"] = reps
+        kw["prewarm"] = _prewarm_reps(pipe, trace)
 
     if warmup:
         for _ in serve_forever(pipe, list(trace), **kw):
@@ -318,6 +335,185 @@ def crash_replay_drill(pipe, trace, journal_path, crash_after: int,
     }
 
 
+class _ShadowJournal:
+    """A Journal that tees every appended WAL line into a side-car shadow
+    file compaction never touches — the drill's full-history oracle: after
+    any number of snapshot/rotate cycles, ``replay(shadow)`` is what a
+    never-compacted journal would fold, so snapshot+tail correctness is
+    *asserted* against it, not assumed."""
+
+    def __init__(self, path, shadow_path):
+        from p2p_tpu.serve import Journal
+
+        self._shadow = open(shadow_path, "a", encoding="utf-8")
+        self.journal = Journal(path)
+        real_append = self.journal._append
+
+        def tee(rec):
+            real_append(rec)
+            self._shadow.write(json.dumps(rec) + "\n")
+            self._shadow.flush()
+
+        self.journal._append = tee
+
+    def close(self):
+        self.journal.close()
+        self._shadow.close()
+
+
+def rolling_restart_drill(pipe, trace, journal_path, *, cycles=3,
+                          kill_mid_drain=False, serve_kw=None) -> dict:
+    """The lifecycle leg (ISSUE 9): N graceful drain/restart cycles
+    mid-trace must be invisible in the results.
+
+    Each cycle opens the same journal (warm restart: snapshot + WAL tail),
+    re-feeds the full trace (already-terminal ids dedupe; drained-pending
+    ones resume), requests a drain after its share of new terminal
+    records, and exits through the drain protocol (snapshot + compaction).
+    ``kill_mid_drain=True`` additionally arms a chaos ``kill_during_drain``
+    in the middle cycle — that drain dies half-way (no compaction, no
+    summary) and the next cycle must still restart exactly-once.
+
+    Invariants raised as :class:`DrillFailure`:
+
+    1. exactly-once: every request id reaches exactly one non-``rejected``
+       terminal across the union of cycles (draining rejections are
+       backpressure, deliberately un-journaled, and may repeat);
+    2. bitwise: every ``ok`` image equals the uninterrupted run's;
+    3. snapshot+tail ≡ full history: at every restart the live journal's
+       fold (pending ids+dicts, terminal map, live hand-offs) is
+       byte-equivalent (JSON) to folding the never-compacted shadow WAL;
+    4. compaction wins: every restart after a completed drain replays
+       strictly fewer WAL records than the full history holds.
+    """
+    from p2p_tpu.serve import replay as replay_fn
+    from p2p_tpu.serve import serve_forever
+    from p2p_tpu.serve.chaos import FaultPlan, SimulatedKill
+    from p2p_tpu.serve.engine_loop import TERMINAL_STATUSES
+    from p2p_tpu.serve.lifecycle import DrainController
+
+    kw = dict(max_batch=4, max_wait_ms=20.0, queue_cap=256,
+              validate_outputs=True, phase2_max_batch=4)
+    kw.update(serve_kw or {})
+    if "prewarm" not in kw:
+        kw["prewarm"] = _prewarm_reps(pipe, trace)
+
+    for p in (journal_path, journal_path + ".shadow",
+              journal_path + ".snapshot"):
+        if os.path.exists(p):
+            os.remove(p)
+
+    clean = list(serve_forever(pipe, list(trace), **kw))
+    clean_by_id = check_exactly_once(trace, clean, "uninterrupted run")
+
+    n_requests = len(clean_by_id)
+    # One share per cycle plus one spare: a drain completes its in-flight
+    # work past the trigger, so later cycles must still have enough left
+    # to drain again (deterministic either way under a fixed timer).
+    quota = max(1, n_requests // (cycles + 1))
+    shadow = journal_path + ".shadow"
+    resolved: dict = {}
+    drains = completed_drains = kills = 0
+    restart_tails = []
+    full_history_records = 0
+
+    def _shadow_records():
+        with open(shadow) as f:
+            return sum(1 for l in f if l.strip())
+
+    def _fold_key(state):
+        """The comparable fold: pending (ids + dicts, in order), terminal
+        map, and live hand-offs keyed to their spill (path + spec)."""
+        live = set(state.pending_ids)
+        return json.dumps({
+            "pending": state.pending,
+            "terminal": dict(sorted(state.terminal.items())),
+            "handoffs": {rid: {"carry_path": rec["carry_path"],
+                               "spec": rec["spec"]}
+                         for rid, rec in sorted(state.handoffs.items())
+                         if rid in live}}, sort_keys=True)
+
+    for cycle in range(cycles):
+        ctl = DrainController()
+        sj = _ShadowJournal(journal_path, shadow)
+        live_state = sj.journal.replay_state
+        if cycle > 0:
+            full = replay_fn(shadow, sweep=False)
+            if _fold_key(live_state) != _fold_key(full):
+                raise DrillFailure(
+                    f"rolling-restart cycle {cycle}: snapshot+tail fold "
+                    f"diverged from the full-history fold")
+            restart_tails.append(live_state.wal_records)
+            full_history_records = full.wal_records
+            if completed_drains and not \
+                    live_state.wal_records < full_history_records:
+                raise DrillFailure(
+                    f"rolling-restart cycle {cycle}: compaction won "
+                    f"nothing — tail replayed {live_state.wal_records} "
+                    f"records vs {full_history_records} full history")
+        chaos = None
+        if kill_mid_drain and cycle == cycles // 2:
+            # Armed at the cycle's first dispatch; fires after the first
+            # drain-mode dispatch — this drain dies half-way.
+            chaos = FaultPlan(by_batch={1: "kill_during_drain"})
+        last = cycle == cycles - 1
+        count = 0
+        killed = False
+        gen = serve_forever(pipe, list(trace), journal=sj.journal,
+                            lifecycle=ctl, chaos=chaos, **kw)
+        recs = []
+        try:
+            for rec in gen:
+                recs.append(rec)
+                if rec.get("status") in TERMINAL_STATUSES and \
+                        rec["status"] != "rejected":
+                    count += 1
+                    if not last and count >= quota and not ctl.requested:
+                        ctl.request(f"rolling cycle {cycle}")
+                        drains += 1
+        except SimulatedKill:
+            killed = True
+            kills += 1
+            sj.journal._f.close()   # simulated death: no clean close
+            sj._shadow.close()
+        if not killed:
+            if ctl.requested and recs and "drain" not in recs[-1]:
+                raise DrillFailure(f"rolling-restart cycle {cycle}: drain "
+                                   f"requested but the summary shows none")
+            if ctl.requested:
+                completed_drains += 1
+            sj.close()
+        for rec in recs:
+            status = rec.get("status")
+            if status not in TERMINAL_STATUSES or status == "rejected":
+                continue
+            rid = rec["request_id"]
+            if rid in resolved:
+                raise DrillFailure(
+                    f"rolling-restart: request {rid!r} resolved twice "
+                    f"({resolved[rid]['status']} then {status})")
+            resolved[rid] = rec
+
+    ids = [r["request_id"] for r in trace if "request_id" in r]
+    missing = [rid for rid in ids if rid not in resolved]
+    if missing:
+        raise DrillFailure(f"rolling-restart: {len(missing)} request(s) "
+                           f"lost across the cycles: {missing[:5]}")
+    bitwise = check_bitwise_vs_clean(clean_by_id, resolved)
+    counts: dict = {}
+    for rec in resolved.values():
+        counts[rec["status"]] = counts.get(rec["status"], 0) + 1
+    return {"cycles": cycles,
+            "n_requests": n_requests,
+            "drains": drains,
+            "completed_drains": completed_drains,
+            "kills": kills,
+            "counts": counts,
+            "bitwise_compared": bitwise,
+            "restart_tail_records": restart_tails,
+            "full_history_records": full_history_records}
+
+
 def main(argv=None) -> int:
     _pin_cpu()
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -341,7 +537,20 @@ def main(argv=None) -> int:
                          "journaled run after K terminal records, restart, "
                          "assert exactly-once across both")
     ap.add_argument("--journal", default=None,
-                    help="WAL path for --crash-after (default: a tempdir)")
+                    help="WAL path for --crash-after/--rolling "
+                         "(default: a tempdir)")
+    ap.add_argument("--rolling", type=int, default=None, metavar="N",
+                    help="also run the rolling-restart lifecycle leg: N "
+                         "graceful drain/restart cycles mid-trace (journal "
+                         "snapshot+compaction at each drain) must yield "
+                         "exactly-once terminals, ok-outputs bitwise-"
+                         "identical to the uninterrupted run, and "
+                         "snapshot+tail restarts that replay strictly "
+                         "fewer WAL records than the full history")
+    ap.add_argument("--kill-mid-drain", action="store_true",
+                    help="with --rolling: arm a chaos kill_during_drain in "
+                         "the middle cycle (that drain dies half-way; the "
+                         "restart must still be exactly-once)")
     ap.add_argument("--warmup", action="store_true",
                     help="one unmeasured clean pass first, so the p95 "
                          "delta is retry cost, not compile noise")
@@ -370,6 +579,12 @@ def main(argv=None) -> int:
         result = run_drill(pipe, trace, plan, watchdog_ms=args.watchdog_ms,
                            journal_path=args.journal,
                            crash_after=args.crash_after, warmup=args.warmup)
+        if args.rolling:
+            jpath = args.journal or os.path.join(
+                tempfile.mkdtemp(prefix="p2p-rolling-"), "rolling.wal")
+            result["rolling_restart"] = rolling_restart_drill(
+                pipe, [r for r in trace if "cancel" not in r], jpath,
+                cycles=args.rolling, kill_mid_drain=args.kill_mid_drain)
     except DrillFailure as e:
         print(f"DRILL FAILED: {e}", file=sys.stderr)
         return 1

@@ -26,6 +26,23 @@ pre-gate-mix traces.
     python tools/loadgen.py --n 48 --mode poisson --rate 20 --seed 0 \
         --steps 4 --out demo.jsonl
 
+``--duration-ms`` switches to the streaming long-trace mode
+(:func:`generate_stream`): requests are emitted one line at a time until
+the virtual-clock horizon, never materialized — tools/soak.py drives
+hours-equivalent traces through it. The RNG draws per request (gap, seed,
+optional gate) in request order, so the first K requests of a stream are
+byte-identical to the finite ``--n K`` trace with the same seed — the
+seed-stable prefix contract pinned in tests/test_loadgen.py.
+
+Compat note (ISSUE 9): the per-request draw order replaced the original
+vectorized draws (all gaps first, then seeds), so a given (seed, n)
+poisson trace has different arrivals/seeds than the same invocation
+produced before the lifecycle PR. Every in-repo consumer compares
+within-run (drills, parity legs, bench A/B), but committed BENCH rounds
+recorded before the change ran a *different seeded workload* for their
+``serve``/``resilience`` blocks than post-change rounds will — treat the
+bench-trend comparison across that boundary accordingly.
+
 Two optional schedule sections make a trace a chaos drill
 (tools/chaos_drill.py):
 
@@ -82,8 +99,10 @@ def parse_gate_mix(spec: str) -> List[tuple]:
     return out
 
 
-def generate_trace(
-    n: int,
+def generate_stream(
+    duration_ms: Optional[float] = None,
+    *,
+    n: Optional[int] = None,
     mode: str = "poisson",
     rate_per_s: float = 20.0,
     seed: int = 0,
@@ -95,21 +114,26 @@ def generate_trace(
     distinct_keys: int = 1,
     gate=None,
     gate_mix: Optional[List[tuple]] = None,
-) -> List[dict]:
-    """Build ``n`` request dicts sorted by ``arrival_ms`` (deterministic in
-    ``seed``). See the module docstring for the two modes. ``gate_mix``
-    (:func:`parse_gate_mix` pairs) draws each request's gate from the
-    weighted distribution — it overrides ``gate``, and the draws ride a
-    separate seed-derived RNG stream, so arrivals and seeds stay
-    byte-identical to the no-mix trace."""
+):
+    """Yield request dicts in arrival order until ``arrival_ms`` would
+    exceed ``duration_ms`` (and/or ``n`` requests have been produced; both
+    ``None`` = unbounded) — the streaming long-trace mode: a multi-hour
+    virtual-clock soak trace is never materialized in memory.
+
+    **Seed-stable prefix contract** (pinned in tests/test_loadgen.py): the
+    RNG draws per request, in request order — one interarrival gap, one
+    seed, then (with a mix) one gate draw on the separate derived stream —
+    so any prefix of a stream is independent of the horizon: the first K
+    requests are byte-identical for every ``duration_ms``/``n`` ≥ K, and
+    :func:`generate_trace` is literally ``list(generate_stream(n=K))``."""
     import numpy as np
 
-    if n < 1:
-        raise ValueError(f"n must be >= 1, got {n}")
     if mode not in ("poisson", "burst"):
         raise ValueError(f"mode must be 'poisson' or 'burst', got {mode!r}")
     if rate_per_s <= 0:
         raise ValueError(f"rate_per_s must be positive, got {rate_per_s}")
+    if duration_ms is not None and duration_ms < 0:
+        raise ValueError(f"duration_ms must be >= 0, got {duration_ms}")
     if gate_mix is not None:
         total_w = sum(w for _, w in gate_mix)
         cuts = np.cumsum([w / total_w for _, w in gate_mix])
@@ -118,15 +142,22 @@ def generate_trace(
         # byte-identical to the no-mix trace everywhere but 'gate'.
         gate_rng = np.random.RandomState(seed ^ 0x6A7E)
     rng = np.random.RandomState(seed)
-    if mode == "poisson":
-        gaps = rng.exponential(1000.0 / rate_per_s, size=n)
-        gaps[0] = 0.0
-        arrivals = np.cumsum(gaps)
-    else:
-        arrivals = np.array([(i // burst_size) * burst_gap_ms
-                             for i in range(n)], dtype=np.float64)
-    out = []
-    for i, at in enumerate(arrivals):
+    at = 0.0
+    i = 0
+    while True:
+        if n is not None and i >= n:
+            return
+        if mode == "poisson":
+            # The gap is drawn for every request (i=0's is discarded, not
+            # skipped) so per-request RNG consumption is uniform — the
+            # prefix-stability invariant.
+            gap = float(rng.exponential(1000.0 / rate_per_s))
+            if i:
+                at += gap
+        else:
+            at = (i // burst_size) * burst_gap_ms
+        if duration_ms is not None and at > duration_ms:
+            return
         src, tgt = _CORPUS[i % len(_CORPUS)]
         req = {
             "request_id": f"{mode}-{seed:04d}-{i:04d}",
@@ -148,8 +179,57 @@ def generate_trace(
             req["gate"] = req_gate
         if deadline_ms is not None:
             req["deadline_ms"] = deadline_ms
-        out.append(req)
-    return out
+        yield req
+        i += 1
+
+
+def generate_trace(
+    n: int,
+    mode: str = "poisson",
+    rate_per_s: float = 20.0,
+    seed: int = 0,
+    steps: int = 50,
+    scheduler: str = "ddim",
+    burst_size: int = 8,
+    burst_gap_ms: float = 500.0,
+    deadline_ms: Optional[float] = None,
+    distinct_keys: int = 1,
+    gate=None,
+    gate_mix: Optional[List[tuple]] = None,
+) -> List[dict]:
+    """Build ``n`` request dicts sorted by ``arrival_ms`` (deterministic in
+    ``seed``) — the finite materialized form of :func:`generate_stream`,
+    and byte-identical to its first ``n`` yields (the seed-stable prefix
+    contract). ``gate_mix`` (:func:`parse_gate_mix` pairs) draws each
+    request's gate from the weighted distribution — it overrides ``gate``,
+    and the draws ride a separate seed-derived RNG stream, so arrivals and
+    seeds stay byte-identical to the no-mix trace."""
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    return list(generate_stream(
+        None, n=n, mode=mode, rate_per_s=rate_per_s, seed=seed, steps=steps,
+        scheduler=scheduler, burst_size=burst_size,
+        burst_gap_ms=burst_gap_ms, deadline_ms=deadline_ms,
+        distinct_keys=distinct_keys, gate=gate, gate_mix=gate_mix))
+
+
+def stream_with_cancels(stream, seed: int, rate: float):
+    """Streaming form of :func:`with_cancels` — same semantics (each
+    seeded victim is cancelled right after the next arrival), same derived
+    RNG stream, O(1) memory."""
+    import numpy as np
+
+    if not 0.0 <= rate <= 1.0:
+        raise ValueError(f"cancel rate must be in [0, 1], got {rate}")
+    rng = np.random.RandomState(seed ^ 0x5CA1AB1E)
+    pending_cancel = None
+    for req in stream:
+        yield req
+        if pending_cancel is not None:
+            yield {"cancel": pending_cancel}
+            pending_cancel = None
+        if rng.random_sample() < rate:
+            pending_cancel = req["request_id"]
 
 
 def with_cancels(trace: List[dict], seed: int, rate: float) -> List[dict]:
@@ -158,22 +238,9 @@ def with_cancels(trace: List[dict], seed: int, rate: float) -> List[dict]:
     so it is in the queue but (usually) not yet dispatched. The last
     request has no later arrival to ride and is never a victim. Cancel
     markers carry no ``arrival_ms`` — the serve trace parser times them by
-    stream position."""
-    import numpy as np
-
-    if not 0.0 <= rate <= 1.0:
-        raise ValueError(f"cancel rate must be in [0, 1], got {rate}")
-    rng = np.random.RandomState(seed ^ 0x5CA1AB1E)
-    out: List[dict] = []
-    pending_cancel = None
-    for req in trace:
-        out.append(req)
-        if pending_cancel is not None:
-            out.append({"cancel": pending_cancel})
-            pending_cancel = None
-        if rng.random_sample() < rate:
-            pending_cancel = req["request_id"]
-    return out
+    stream position. (The materialized form of
+    :func:`stream_with_cancels`.)"""
+    return list(stream_with_cancels(iter(trace), seed, rate))
 
 
 def fault_plan_dict(trace: List[dict], seed: int, rate: float,
@@ -196,6 +263,13 @@ def fault_plan_dict(trace: List[dict], seed: int, rate: float,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--n", type=int, default=48)
+    ap.add_argument("--duration-ms", type=float, default=None, metavar="MS",
+                    help="streaming long-trace mode: emit requests until "
+                         "arrival_ms exceeds this virtual-clock horizon, "
+                         "one line at a time (nothing materialized — soak "
+                         "traces can be hours-equivalent). Overrides --n; "
+                         "incompatible with --fault-rate, whose plan needs "
+                         "the finite id list")
     ap.add_argument("--mode", choices=("poisson", "burst"), default="poisson")
     ap.add_argument("--rate", type=float, default=20.0,
                     help="poisson arrival rate, requests/second")
@@ -242,6 +316,27 @@ def main(argv=None) -> int:
     if isinstance(gate, str) and gate != "auto":
         gate = float(gate) if "." in gate else int(gate)
     gate_mix = parse_gate_mix(args.gate_mix) if args.gate_mix else None
+    if args.duration_ms is not None:
+        if args.fault_rate > 0:
+            ap.error("--fault-rate needs a finite --n trace (the fault "
+                     "plan draws over the complete request-id list)")
+        stream = generate_stream(
+            args.duration_ms, mode=args.mode, rate_per_s=args.rate,
+            seed=args.seed, steps=args.steps, scheduler=args.scheduler,
+            burst_size=args.burst_size, burst_gap_ms=args.burst_gap_ms,
+            deadline_ms=args.deadline_ms, distinct_keys=args.distinct_keys,
+            gate=gate, gate_mix=gate_mix)
+        if args.cancel_rate > 0:
+            stream = stream_with_cancels(stream, args.seed,
+                                         args.cancel_rate)
+        out = open(args.out, "w") if args.out else sys.stdout
+        try:
+            for req in stream:
+                out.write(json.dumps(req) + "\n")
+        finally:
+            if out is not sys.stdout:
+                out.close()
+        return 0
     trace = generate_trace(
         args.n, mode=args.mode, rate_per_s=args.rate, seed=args.seed,
         steps=args.steps, scheduler=args.scheduler,

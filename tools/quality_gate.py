@@ -222,6 +222,60 @@ def _flight_parity():
             n_attr_ok, chain_ok)
 
 
+def _lifecycle():
+    """The lifecycle-durability contract (ISSUE 9), gated on the chaos
+    drill's rolling-restart leg: a deterministic (zero-timer) seeded
+    gate-mix trace served through 4 cycles = 3 drain/restart boundaries —
+    journal snapshot + compaction at each drain, a chaos
+    ``kill_during_drain`` in the middle cycle — must produce exactly-once
+    terminals, ok-outputs bitwise-identical to the uninterrupted run,
+    snapshot+tail folds byte-equivalent to the never-compacted shadow
+    WAL, and restarts that replay strictly fewer WAL records than the
+    full history. ``rolling_restart_drill`` raises on any violation; the
+    returned facts let the gate insist the drill actually drilled."""
+    import importlib.util
+    import tempfile
+
+    spec = importlib.util.spec_from_file_location(
+        "p2p_chaos_drill", os.path.join(_REPO, "tools", "chaos_drill.py"))
+    drill = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(drill)
+
+    pipe = drill.tiny_pipeline()
+    trace, _ = drill.standard_trace(n=24, seed=8, steps=4, fault_rate=0.0,
+                                    cancel_rate=0.0, gate_mix="0.5:3,off:1")
+    jpath = os.path.join(tempfile.mkdtemp(prefix="p2p-lifecycle-"),
+                         "rolling.wal")
+    return drill.rolling_restart_drill(
+        pipe, trace, jpath, cycles=4, kill_mid_drain=True,
+        serve_kw={"timer": lambda: 0.0})
+
+
+def _soak():
+    """The opt-in long-horizon soak rehearsal (ISSUE 9 acceptance): ≥500
+    virtual-clock-served requests across ≥5 snapshot/compact/restart
+    cycles with WAL+spill disk bounded by a constant, zero fd/thread
+    leaks, bounded RSS growth, and attribution-exact flight records at
+    every cycle. Fake-runner volume drill (tools/soak.py) — the real-
+    runner correctness half is the default ``lifecycle`` check."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "p2p_soak", os.path.join(_REPO, "tools", "soak.py"))
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+    spec2 = importlib.util.spec_from_file_location(
+        "p2p_chaos_drill", os.path.join(_REPO, "tools", "chaos_drill.py"))
+    drill = importlib.util.module_from_spec(spec2)
+    spec2.loader.exec_module(drill)
+    pipe = drill.tiny_pipeline()
+    return soak.run_soak(
+        pipe, cycles=6, duration_ms=30000.0, rate_per_s=20.0, seed=0,
+        steps=4, snapshot_every_ms=4000.0, drain_timeout_ms=60.0,
+        min_requests=500, min_cycles=5,
+        progress=lambda msg: print("  " + msg))
+
+
 def _obs_overhead(reps=4):
     """(overhead_frac, bitwise_identical, step_events) for the telemetry
     path (ISSUE 3): the same tiny sampling run with metrics enabled (step
@@ -339,6 +393,16 @@ def main(argv=None) -> int:
                     help="skip the chaos/crash-replay resilience check "
                          "(ISSUE 4; ~35s: it serves the standard trace "
                          "four times)")
+    ap.add_argument("--skip-lifecycle", action="store_true",
+                    help="skip the rolling-restart lifecycle check "
+                         "(ISSUE 9; ~30s: 3 drain/restart cycles over a "
+                         "gated trace, real runners)")
+    ap.add_argument("--soak", action="store_true",
+                    help="also run the opt-in soak rehearsal (ISSUE 9): "
+                         "≥500 requests across ≥5 snapshot/compact/"
+                         "restart cycles with bounded disk/RSS/fd/thread "
+                         "invariants (fake runners, ~1 min); also "
+                         "reachable as --only soak")
     ap.add_argument("--skip-static", action="store_true",
                     help="skip the static-analysis check (ISSUE 5; ~60s: "
                          "AST lints + traced-program contracts + the "
@@ -360,12 +424,12 @@ def main(argv=None) -> int:
         unknown = only - set(cases) - {"phase_gate", "serve_parity",
                                        "obs_overhead", "fault_drill",
                                        "static_analysis", "flight_parity",
-                                       "bench_trend"}
+                                       "bench_trend", "lifecycle", "soak"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
                      f"valid: {', '.join(cases)}, phase_gate, serve_parity, "
                      f"obs_overhead, fault_drill, static_analysis, "
-                     f"flight_parity, bench_trend")
+                     f"flight_parity, bench_trend, lifecycle, soak")
 
     drifted = []
     for name, fn in cases.items():
@@ -472,6 +536,44 @@ def main(argv=None) -> int:
                   f"{'ok' if ok else 'DRIFT'}")
             if not ok:
                 drifted.append("fault_drill")
+
+    if not args.skip_lifecycle and (only is None or "lifecycle" in only):
+        try:
+            res = _lifecycle()
+        except AssertionError as e:  # DrillFailure: an invariant broke
+            print(f"{'lifecycle':16s} INVARIANT VIOLATED: {e}")
+            drifted.append("lifecycle")
+        else:
+            tails = res["restart_tail_records"]
+            ok = (res["cycles"] == 4 and res["completed_drains"] >= 2
+                  and res["kills"] == 1 and res["bitwise_compared"] > 0
+                  # Every restart after a completed drain replayed a tail
+                  # strictly smaller than the full history (the drill
+                  # raises otherwise; insist it measured something).
+                  and len(tails) == res["cycles"] - 1
+                  and res["full_history_records"] > max(tails))
+            print(f"{'lifecycle':16s} {res['completed_drains']} drains + "
+                  f"{res['kills']} mid-drain kill over {res['cycles']} "
+                  f"cycles, {res['bitwise_compared']} ok outputs bitwise, "
+                  f"restart tails {tails} vs {res['full_history_records']} "
+                  f"full-history records {'ok' if ok else 'DRIFT'}")
+            if not ok:
+                drifted.append("lifecycle")
+
+    if args.soak or (only is not None and "soak" in only):
+        # Opt-in volume rehearsal — minutes of fake-runner traffic; the
+        # default lifecycle check already covers correctness.
+        try:
+            res = _soak()
+        except AssertionError as e:
+            print(f"{'soak':16s} INVARIANT VIOLATED: {e}")
+            drifted.append("soak")
+        else:
+            print(f"{'soak':16s} {res['requests_served']} requests / "
+                  f"{res['cycles']} cycles, disk ≤ "
+                  f"{max(res['disk_bytes_per_cycle'])}B, rss +"
+                  f"{res['rss_growth_kb']}kB, {res['snapshots_total']} "
+                  f"snapshots ok")
 
     if not args.skip_static and (only is None or "static_analysis" in only):
         ok, new, n_contracts, bad_contracts, n_fields, bad_fields, detail = \

@@ -1,0 +1,442 @@
+"""Long-horizon lifecycle soak: hours-equivalent virtual-clock traffic
+through repeated snapshot / compact / drain / restart cycles, with hard
+resource invariants asserted at every cycle boundary.
+
+The chaos drill proves the lifecycle machinery is *correct* (exactly-once,
+bitwise, snapshot+tail ≡ full history); this drill proves it is
+*durable*: a server that drains, snapshots and warm-restarts many times
+over a long horizon must not slowly rot. One streaming loadgen trace
+(``generate_stream`` — never materialized) is re-fed to every incarnation;
+the journal dedupes what earlier cycles already served, each cycle drains
+after its share of new terminals, snapshots + compacts, and the next
+incarnation warm-restarts from snapshot + WAL tail. Asserted per cycle:
+
+- **exactly-once** — no request id ever reaches two non-``rejected``
+  terminals across the whole soak (draining rejections are backpressure
+  and may repeat), and every generated request is eventually served;
+- **bounded disk** — WAL + carry-spill bytes at each cycle boundary stay
+  under a constant, *not* monotone in requests served (compaction + the
+  orphan sweep are what make this true);
+- **bounded restart cost** — every warm restart replays only the WAL tail
+  (a handful of records), never the cumulative history;
+- **no resource leaks** — RSS growth across the soak stays under a
+  budget; the open-fd count and thread count end where they started;
+- **metrics/flight invariants** — every flight record the tracer closes
+  is an ``ok`` with ``attribution_ok`` (stage segments tile the whole
+  virtual-clock lifetime), each summary's counts reconcile with the
+  records seen, and every cycle actually snapshotted.
+
+Fake runners by default (the lifecycle machinery is runner-agnostic and
+the point is volume: hundreds of requests, many cycles, seconds of wall
+clock); phase-1 runners return carries shaped exactly like the request's
+pinned ``carry_template`` so hand-off spills round-trip and mid-drain
+pending work genuinely resumes in phase 2 after a restart. ``--real``
+swaps in the real compiled runners for a slow full-fidelity pass.
+
+    python tools/soak.py                          # rehearsal defaults
+    python tools/soak.py --duration-ms 60000 --rate 20 --cycles 8
+    python tools/soak.py --json soak.json         # machine-readable report
+
+Wired into tools/quality_gate.py as the opt-in ``--only soak`` lane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import math
+import os
+import shutil
+import sys
+import tempfile
+import threading
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+class SoakFailure(AssertionError):
+    """A durability invariant broke during the soak."""
+
+
+def _load_tool(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        f"p2p_{name}", os.path.join(_REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Resource probes (Linux /proc; None-safe elsewhere)
+# ---------------------------------------------------------------------------
+
+
+def rss_kb():
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return None
+
+
+def open_fds():
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None
+
+
+def disk_bytes(journal_path: str) -> int:
+    """WAL + rotated segment + carry spills — the footprint the soak
+    bounds (the snapshot is reported separately: its dedupe map grows
+    with total ids by design, documented in docs/SERVING.md)."""
+    total = 0
+    for p in (journal_path, journal_path + ".old"):
+        if os.path.exists(p):
+            total += os.path.getsize(p)
+    carry = journal_path + ".carry"
+    if os.path.isdir(carry):
+        for name in os.listdir(carry):
+            total += os.path.getsize(os.path.join(carry, name))
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Fake runners: virtual-clock costs, template-shaped carries
+# ---------------------------------------------------------------------------
+
+
+class _VirtualTimer:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt_s):
+        self.t += dt_s
+
+
+def _fake_factory(pipe, timer):
+    """Pool-aware fake runners. Phase-1 runners return carries stacked
+    from the request's real ``carry_template``, so spills validate against
+    the pinned spec and a restarted incarnation resumes drained hand-offs
+    in phase 2 — the full durability path, no U-Net required."""
+    import numpy as np
+
+    from p2p_tpu.serve.handoff import carry_template
+
+    templates: dict = {}
+
+    class Runner:
+        def __init__(self, key, bucket):
+            self.key, self.bucket = key, bucket
+            self.tag = key[0] if key else None
+
+        def warm(self, entries):
+            timer.advance(0.05)
+
+        def __call__(self, entries, guidance):
+            if self.tag == "phase1":
+                import jax
+
+                timer.advance(0.02)
+                prep = entries[0].prepared
+                tkey = prep.phase2_key
+                if tkey not in templates:
+                    templates[tkey] = jax.tree_util.tree_map(
+                        np.asarray, carry_template(pipe, prep))
+                return jax.tree_util.tree_map(
+                    lambda x: np.broadcast_to(
+                        x[None], (self.bucket,) + x.shape).copy(),
+                    templates[tkey])
+            if self.tag == "phase2":
+                for e in entries:
+                    assert e.carry is not None
+                timer.advance(0.01)
+            else:
+                timer.advance(0.03)
+            return np.zeros((self.bucket, 2, 2, 2, 3), np.uint8)
+
+    return lambda key, bucket: Runner(key, bucket)
+
+
+# ---------------------------------------------------------------------------
+# The soak
+# ---------------------------------------------------------------------------
+
+
+def run_soak(pipe, *, cycles=6, duration_ms=30000.0, rate_per_s=20.0,
+             seed=0, steps=4, gate_mix_spec="0.5:1,off:1",
+             snapshot_every_ms=4000.0, drain_timeout_ms=None,
+             workdir=None, real=False, rss_budget_mb=256.0,
+             min_requests=0, min_cycles=0, progress=print) -> dict:
+    """Run the soak; raise :class:`SoakFailure` on any invariant
+    violation; return the report dict."""
+    import time
+
+    from p2p_tpu.obs.flight import FlightTracer
+    from p2p_tpu.serve import Journal, serve_forever
+    from p2p_tpu.serve.engine_loop import TERMINAL_STATUSES
+    from p2p_tpu.serve.lifecycle import DrainController
+
+    loadgen = _load_tool("loadgen")
+    gate_mix = (loadgen.parse_gate_mix(gate_mix_spec)
+                if gate_mix_spec else None)
+
+    def stream():
+        return loadgen.generate_stream(
+            duration_ms, mode="poisson", rate_per_s=rate_per_s, seed=seed,
+            steps=steps, gate_mix=gate_mix)
+
+    n_expected = sum(1 for _ in stream())
+    quota = max(1, math.ceil(n_expected / cycles))
+    owns_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="p2p-soak-")
+    os.makedirs(workdir, exist_ok=True)
+    journal_path = os.path.join(workdir, "soak.wal")
+    for p in (journal_path, journal_path + ".snapshot",
+              journal_path + ".old"):
+        if os.path.exists(p):
+            os.remove(p)
+    if os.path.isdir(journal_path + ".carry"):
+        shutil.rmtree(journal_path + ".carry")
+
+    timer = _VirtualTimer() if not real else time.perf_counter
+    runner_factory = None if real else _fake_factory(pipe, timer)
+
+    resolved: dict = {}
+    per_cycle = []
+    rss0 = fds0 = threads0 = None
+    total_snapshots = 0
+    t_wall0 = time.perf_counter()
+
+    for cycle in range(cycles):
+        gc.collect()
+        if cycle == 0:
+            rss0, fds0 = rss_kb(), open_fds()
+            threads0 = threading.active_count()
+        ctl = DrainController()
+        tracer = FlightTracer()
+        journal = Journal(journal_path)
+        rs = journal.replay_state
+        if cycle > 0:
+            # Bounded restart: a warm restart reads the snapshot plus a
+            # handful of tail records — never the cumulative history.
+            if not rs.snapshot_loaded:
+                raise SoakFailure(f"cycle {cycle}: restart found no "
+                                  f"snapshot to warm-start from")
+            if rs.wal_records > 64:
+                raise SoakFailure(
+                    f"cycle {cycle}: restart replayed {rs.wal_records} "
+                    f"WAL tail records — compaction is not bounding "
+                    f"restart cost")
+        last = cycle == cycles - 1
+        count = 0
+        summary = None
+        for rec in serve_forever(
+                pipe, stream(), journal=journal, lifecycle=ctl,
+                flight=tracer, snapshot_every_ms=snapshot_every_ms,
+                drain_timeout_ms=drain_timeout_ms,
+                runner_factory=runner_factory, timer=timer,
+                max_batch=4, max_wait_ms=25.0, queue_cap=512,
+                phase2_max_batch=4):
+            status = rec.get("status")
+            if status == "summary":
+                summary = rec
+                continue
+            if status not in TERMINAL_STATUSES or status == "rejected":
+                continue
+            rid = rec["request_id"]
+            if rid in resolved:
+                raise SoakFailure(f"request {rid!r} resolved twice "
+                                  f"(cycle {resolved[rid]} then {cycle})")
+            resolved[rid] = cycle
+            count += 1
+            if not last and count >= quota and not ctl.requested:
+                ctl.request(f"soak cycle {cycle}")
+        journal.close()
+
+        # Flight invariants: pure healthy traffic — every closed record
+        # must be an attribution-exact ok (draining rejections close no
+        # flight record by design).
+        for frec in tracer.records:
+            if frec["status"] != "ok":
+                raise SoakFailure(
+                    f"cycle {cycle}: flight record {frec['trace_id']} has "
+                    f"status {frec['status']!r} in a fault-free soak")
+            if not frec.get("attribution_ok"):
+                raise SoakFailure(
+                    f"cycle {cycle}: flight record {frec['trace_id']} "
+                    f"failed attribution "
+                    f"(unattributed {frec['unattributed_ms']}ms)")
+        if summary is None:
+            raise SoakFailure(f"cycle {cycle}: no summary record")
+        if summary["counts"]["ok"] != len(tracer.records):
+            raise SoakFailure(
+                f"cycle {cycle}: summary says {summary['counts']['ok']} "
+                f"ok but the tracer closed {len(tracer.records)} records")
+        snaps = summary.get("snapshots", 0)
+        if snaps < 1 and summary["counts"]["ok"]:
+            # A cycle that served nothing (every id already terminal)
+            # dispatches nothing and so never reaches the snapshot point —
+            # only cycles that did work must have compacted.
+            raise SoakFailure(f"cycle {cycle}: no snapshot taken")
+        total_snapshots += snaps
+
+        gc.collect()
+        facts = {"cycle": cycle,
+                 "served_ok": summary["counts"]["ok"],
+                 "snapshots": snaps,
+                 "restart_tail_records": rs.wal_records,
+                 "orphans_swept": rs.orphans_swept,
+                 "resumed_handoffs": summary.get("phases", {}).get(
+                     "resumed_handoffs", 0),
+                 "disk_bytes": disk_bytes(journal_path),
+                 "snapshot_bytes": (os.path.getsize(
+                     journal_path + ".snapshot")
+                     if os.path.exists(journal_path + ".snapshot") else 0),
+                 "rss_kb": rss_kb(),
+                 "open_fds": open_fds(),
+                 "threads": threading.active_count()}
+        per_cycle.append(facts)
+        progress(f"soak cycle {cycle}: +{facts['served_ok']} ok "
+                 f"({len(resolved)}/{n_expected} total), "
+                 f"disk {facts['disk_bytes']}B, "
+                 f"rss {facts['rss_kb']}kB, fds {facts['open_fds']}")
+
+    # ------------------------------------------------------------------
+    # Whole-soak invariants
+    # ------------------------------------------------------------------
+    failures = []
+    if len(resolved) != n_expected:
+        missing = n_expected - len(resolved)
+        failures.append(f"{missing} request(s) never served")
+    if min_requests and len(resolved) < min_requests:
+        failures.append(f"served {len(resolved)} < required "
+                        f"{min_requests} requests")
+    if min_cycles and cycles < min_cycles:
+        failures.append(f"ran {cycles} < required {min_cycles} cycles")
+
+    # Bounded disk: WAL+spill at every cycle boundary under a constant —
+    # 64KB or twice the first cycle's footprint, whichever is larger —
+    # and in particular NOT monotone in requests served.
+    disk = [f["disk_bytes"] for f in per_cycle]
+    disk_cap = max(65536, 2 * max(disk[0], 1))
+    if max(disk) > disk_cap:
+        failures.append(f"WAL+spill disk grew past the bound: {disk} "
+                        f"(cap {disk_cap})")
+
+    rss = [f["rss_kb"] for f in per_cycle]
+    rss_growth_kb = None
+    if rss0 is not None and all(r is not None for r in rss):
+        rss_growth_kb = rss[-1] - rss0
+        if rss_growth_kb > rss_budget_mb * 1024:
+            failures.append(f"RSS grew {rss_growth_kb}kB > budget "
+                            f"{rss_budget_mb}MB")
+    fds = [f["open_fds"] for f in per_cycle]
+    if fds0 is not None and all(f is not None for f in fds):
+        if fds[-1] > fds0 + 8:
+            failures.append(f"fd leak: {fds0} -> {fds[-1]}")
+    threads = [f["threads"] for f in per_cycle]
+    if threads[-1] > threads0 + 2:
+        failures.append(f"thread leak: {threads0} -> {threads[-1]}")
+
+    report = {"ok": not failures,
+              "failures": failures,
+              "cycles": cycles,
+              "requests_expected": n_expected,
+              "requests_served": len(resolved),
+              "snapshots_total": total_snapshots,
+              "resumed_handoffs_total": sum(
+                  f["resumed_handoffs"] for f in per_cycle),
+              "disk_bytes_per_cycle": disk,
+              "disk_cap_bytes": disk_cap,
+              "rss_growth_kb": rss_growth_kb,
+              "fds_first_last": [fds0, fds[-1]],
+              "threads_first_last": [threads0, threads[-1]],
+              "wall_s": round(time.perf_counter() - t_wall0, 2),
+              "per_cycle": per_cycle}
+    if failures:
+        # Leave the workdir in place as evidence.
+        raise SoakFailure("; ".join(failures) + f" (workdir: {workdir})")
+    if owns_workdir:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return report
+
+
+def main(argv=None) -> int:
+    chaos_drill = _load_tool("chaos_drill")
+    chaos_drill._pin_cpu()
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cycles", type=int, default=6)
+    ap.add_argument("--duration-ms", type=float, default=30000.0,
+                    help="virtual-clock horizon of the streaming trace")
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="poisson arrivals per (virtual) second")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--gate-mix", default="0.5:1,off:1",
+                    help="loadgen gate mix ('' = all ungated); gated "
+                         "requests exercise the hand-off spill path")
+    ap.add_argument("--snapshot-every-ms", type=float, default=4000.0)
+    ap.add_argument("--drain-timeout-ms", type=float, default=None,
+                    help="drain budget per cycle (virtual ms with the fake "
+                         "runners' injected timer): a tight budget leaves "
+                         "pending hand-offs behind, so restarts exercise "
+                         "the phase-2 resume path (default: 60 with fake "
+                         "runners, unbounded with --real)")
+    ap.add_argument("--workdir", default=None,
+                    help="journal directory (default: a fresh tempdir, "
+                         "removed afterwards)")
+    ap.add_argument("--real", action="store_true",
+                    help="real compiled runners + wall clock instead of "
+                         "the fake virtual-clock runners (slow)")
+    ap.add_argument("--rss-budget-mb", type=float, default=256.0)
+    ap.add_argument("--min-requests", type=int, default=500,
+                    help="fail if the horizon produced fewer requests")
+    ap.add_argument("--min-cycles", type=int, default=5)
+    ap.add_argument("--json", default=None, metavar="FILE",
+                    help="also write the report as JSON")
+    args = ap.parse_args(argv)
+
+    drain_timeout = args.drain_timeout_ms
+    if drain_timeout is None and not args.real:
+        drain_timeout = 60.0
+    pipe = chaos_drill.tiny_pipeline()
+    try:
+        report = run_soak(
+            pipe, cycles=args.cycles, duration_ms=args.duration_ms,
+            rate_per_s=args.rate, seed=args.seed, steps=args.steps,
+            gate_mix_spec=args.gate_mix,
+            snapshot_every_ms=args.snapshot_every_ms,
+            drain_timeout_ms=drain_timeout,
+            workdir=args.workdir, real=args.real,
+            rss_budget_mb=args.rss_budget_mb,
+            min_requests=args.min_requests, min_cycles=args.min_cycles,
+            progress=lambda msg: print(msg, file=sys.stderr))
+    except SoakFailure as e:
+        print(f"SOAK FAILED: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(report, indent=2, sort_keys=True))
+    print(f"soak OK: {report['requests_served']} requests across "
+          f"{report['cycles']} snapshot/compact/restart cycles; disk "
+          f"bounded at {max(report['disk_bytes_per_cycle'])}B, RSS growth "
+          f"{report['rss_growth_kb']}kB", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
