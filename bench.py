@@ -956,6 +956,67 @@ def _measure(preset):
                 "two_pool_p95_ms": round(s_two["p95_ms"], 2),
             }
 
+            # Mesh-parallel serving (ISSUE 10): the same two-pool engine
+            # sharded over a dp device mesh, with loadgen driving 10x the
+            # Poisson rate so the wider buckets actually fill. The devices
+            # axis records how many chips the serve batch dimension spans;
+            # dp=1 vs dp=N makespans give the scaling ratio and the
+            # per-device img/s — the on-chip near-linear-scaling claim is
+            # what the next chip window measures from these same keys
+            # (a linear-batch-cost CPU host repacks equal compute, so the
+            # rehearsal ratio sits near 1.0, exactly like the phases A/B).
+            from p2p_tpu.serve import MeshSpec
+
+            ndev = len(jax.devices())
+            dp = 1
+            while dp * 2 <= min(ndev, 4):
+                dp *= 2
+            n4 = 12 if full else 24
+            trace4 = loadgen.generate_trace(
+                n4, mode="poisson", rate_per_s=500.0, seed=2,
+                steps=num_steps, gate_mix=mix)
+            pre4_r = [Request.from_dict(d) for d in trace4]
+            pre4 = ([r for r in pre4_r if r.gate is not None][:1]
+                    + [r for r in pre4_r if r.gate is None][:1])
+
+            def run_mesh(spec):
+                s = None
+                ok = imgs = 0
+                for rec in serve_forever(pipe,
+                                         [Request.from_dict(d)
+                                          for d in trace4],
+                                         max_batch=2, max_wait_ms=100.0,
+                                         prewarm=pre4, mesh=spec):
+                    if rec["status"] == "ok":
+                        ok += 1
+                        imgs += len(rec["images"])
+                    elif rec["status"] == "summary":
+                        s = rec
+                if ok != n4:
+                    raise RuntimeError(
+                        f"serve mesh leg (dp={spec.dp}) served {ok}/{n4} "
+                        f"(counts: {s and s['counts']})")
+                return s, imgs
+
+            run_mesh(MeshSpec(dp=1))            # warm both mesh shapes'
+            run_mesh(MeshSpec(dp=dp))           # programs before timing
+            s_dp1, _ = run_mesh(MeshSpec(dp=1))
+            s_mesh, imgs_mesh = run_mesh(MeshSpec(dp=dp))
+            mesh_s = s_mesh["makespan_ms"] / 1000.0
+            phm = s_mesh["phases"]
+            extras["serve"]["mesh"] = {
+                "devices": dp,
+                "n_requests": n4,
+                "dp1_makespan_ms": round(s_dp1["makespan_ms"], 1),
+                "mesh_makespan_ms": round(s_mesh["makespan_ms"], 1),
+                "scaling_ratio": round(
+                    s_dp1["makespan_ms"] / s_mesh["makespan_ms"], 3),
+                "imgs_per_s_per_device": round(imgs_mesh / mesh_s / dp, 4),
+                "phase2_pack_p50": phm["phase2"]["pack_p50"],
+                "phase2_max_batch": phm["phase2_max_batch"],
+                "handoffs": phm["handoffs"],
+            }
+
         # Telemetry-overhead block (ISSUE 3): the same headline single-group
         # edit run with the obs instrumentation enabled (phase-tagged step
         # callbacks traced in, host collector installed) vs disabled, so

@@ -35,10 +35,15 @@ Programs traced (:func:`canonical_programs`): text2image ungated + gated
 ``BUCKET_SIZES`` padding contract), the disaggregated phase-1/phase-2
 POOL programs at the same buckets (phase-disaggregated continuous
 batching — ``phase2-footprint`` pairs each phase-2 pool program with its
-phase-1 twin, since each pool compiles a single scan), and the two
-inversion programs. The tiny pipeline is the same construction the golden
-tests use (random weights; contracts are shape/structure properties,
-weights never matter).
+phase-1 twin, since each pool compiles a single scan), the SHARDED serve
+programs (mesh-parallel serving: the same three serve tracers with their
+group-axis inputs placed under a ``NamedSharding(P("dp"))`` on a live
+``dp`` mesh — ``dp=2`` when the process has the devices, degrading to a
+one-device mesh otherwise, so the sweep always runs; the behavioral mesh
+legs live in tests/test_serve_mesh.py and the ``mesh_parity`` quality
+gate), and the two inversion programs. The tiny pipeline is the same
+construction the golden tests use (random weights; contracts are
+shape/structure properties, weights never matter).
 """
 
 from __future__ import annotations
@@ -153,7 +158,27 @@ def _trace_denoise(pipe, ctrl, gate, metrics):
     return jax.make_jaxpr(run)(pipe.unet_params, ctx, lats, gs)
 
 
-def _trace_sweep(pipe, ctrl, bucket, gate, metrics):
+def _mesh_dp() -> int:
+    """The dp width the sharded canonical programs trace at: 2 when the
+    process has at least two devices, else a one-device mesh — the sweep
+    must run everywhere the analyzer does (a bare ``p2p-tpu check
+    --static`` sees one CPU device; the test/gate environments force a
+    virtual 8-device platform)."""
+    import jax
+
+    return 2 if len(jax.devices()) >= 2 else 1
+
+
+def _stage_dp(x, mesh):
+    """Place a group-axis value under the serve mesh's data sharding —
+    exactly what the engine's dispatch staging does."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.device_put(x, NamedSharding(mesh, P("dp")))
+
+
+def _trace_sweep(pipe, ctrl, bucket, gate, metrics, mesh=None):
     import jax
     import jax.numpy as jnp
 
@@ -170,6 +195,10 @@ def _trace_sweep(pipe, ctrl, bucket, gate, metrics):
     lat_g = jnp.broadcast_to(lats[None], (bucket,) + lats.shape)
     ctrl_g = (None if ctrl is None else jax.tree_util.tree_map(
         lambda x: jnp.broadcast_to(x[None], (bucket,) + x.shape), ctrl))
+    if mesh is not None:
+        ctx_g, lat_g = _stage_dp(ctx_g, mesh), _stage_dp(lat_g, mesh)
+        ctrl_g = (None if ctrl_g is None else jax.tree_util.tree_map(
+            lambda x: _stage_dp(x, mesh), ctrl_g))
 
     def run(up, vp, ctx_g, lat_g, ctrl_g, gs):
         return _sweep_jit(up, vp, cfg, layout, schedule, "ddim", ctx_g,
@@ -203,7 +232,7 @@ def _zero_carry(pipe, ctrl):
         state=state)
 
 
-def _trace_sweep_phase1(pipe, ctrl, bucket, gate, metrics):
+def _trace_sweep_phase1(pipe, ctrl, bucket, gate, metrics, mesh=None):
     import jax
     import jax.numpy as jnp
 
@@ -220,6 +249,10 @@ def _trace_sweep_phase1(pipe, ctrl, bucket, gate, metrics):
     lat_g = jnp.broadcast_to(lats[None], (bucket,) + lats.shape)
     ctrl_g = (None if ctrl is None else jax.tree_util.tree_map(
         lambda x: jnp.broadcast_to(x[None], (bucket,) + x.shape), ctrl))
+    if mesh is not None:
+        ctx_g, lat_g = _stage_dp(ctx_g, mesh), _stage_dp(lat_g, mesh)
+        ctrl_g = (None if ctrl_g is None else jax.tree_util.tree_map(
+            lambda x: _stage_dp(x, mesh), ctrl_g))
 
     def run(up, ctx_g, lat_g, ctrl_g, gs):
         return _sweep_phase1_jit(up, cfg, layout, schedule, "ddim", ctx_g,
@@ -229,7 +262,7 @@ def _trace_sweep_phase1(pipe, ctrl, bucket, gate, metrics):
     return jax.make_jaxpr(run)(pipe.unet_params, ctx_g, lat_g, ctrl_g, gs)
 
 
-def _trace_sweep_phase2(pipe, ctrl, bucket, gate, metrics):
+def _trace_sweep_phase2(pipe, ctrl, bucket, gate, metrics, mesh=None):
     import jax
     import jax.numpy as jnp
 
@@ -252,6 +285,12 @@ def _trace_sweep_phase2(pipe, ctrl, bucket, gate, metrics):
     ctx_g = lead(cond)
     carry_g = jax.tree_util.tree_map(lead, carry)
     ctrl_g = None if p2 is None else jax.tree_util.tree_map(lead, p2)
+    if mesh is not None:
+        ctx_g = _stage_dp(ctx_g, mesh)
+        carry_g = jax.tree_util.tree_map(lambda x: _stage_dp(x, mesh),
+                                         carry_g)
+        ctrl_g = (None if ctrl_g is None else jax.tree_util.tree_map(
+            lambda x: _stage_dp(x, mesh), ctrl_g))
     gs = jnp.float32(7.5)
 
     def run(up, vp, ctx_g, carry_g, ctrl_g, gs):
@@ -334,6 +373,32 @@ def canonical_programs(pipe=None, buckets=(1, 2, 4, 8),
             _trace_sweep_phase2(pipe, ctrl, bucket=g, gate=GATE,
                                 metrics=metrics),
             group_batch=b, gate=GATE, metrics=metrics, lead_dims=(g,)))
+    # Sharded serve programs (mesh-parallel serving): the same three serve
+    # tracers with group-axis inputs placed under NamedSharding(P("dp")) on
+    # a live dp mesh — the engine's `--mesh` dispatch shape. One bucket of
+    # dp whole per-device lanes keeps the sweep cheap; the footprint pair
+    # uses the same phase1-/phase2- naming so it pairs like the rest.
+    from ..parallel.mesh import make_mesh
+
+    dp = _mesh_dp()
+    mesh = make_mesh(dp, tp=1)
+    g = dp * 2  # two lanes per device: the doubled-batch detector stays
+    #             non-vacuous and the per-device sub-batch is a real batch
+    programs.append(Program(
+        f"serve/mesh-dp{dp}x{g}",
+        _trace_sweep(pipe, ctrl, bucket=g, gate=GATE, metrics=metrics,
+                     mesh=mesh),
+        group_batch=b, gate=GATE, metrics=metrics, lead_dims=(g,)))
+    programs.append(Program(
+        f"serve/phase1-mesh-dp{dp}x{g}",
+        _trace_sweep_phase1(pipe, ctrl, bucket=g, gate=GATE,
+                            metrics=metrics, mesh=mesh),
+        group_batch=b, gate=GATE, metrics=metrics, lead_dims=(g,)))
+    programs.append(Program(
+        f"serve/phase2-mesh-dp{dp}x{g}",
+        _trace_sweep_phase2(pipe, ctrl, bucket=g, gate=GATE,
+                            metrics=metrics, mesh=mesh),
+        group_batch=b, gate=GATE, metrics=metrics, lead_dims=(g,)))
     inv, null = _trace_invert(pipe, metrics=metrics)
     programs.append(Program("invert/ddim", inv, group_batch=1, gate=None,
                             metrics=metrics))

@@ -458,14 +458,23 @@ def cmd_serve(args) -> int:
     from .obs import metrics as obs_metrics
     from .obs import spans as obs_spans
     from .serve import (DegradeConfig, DrainController, FaultPlan, Journal,
-                        Request, parse_jsonl_line, serve_forever,
-                        signal_drain)
+                        Request, parse_jsonl_line, parse_mesh,
+                        serve_forever, signal_drain)
     from .utils.progress import trace as prof_trace
 
     if args.snapshot_every_ms is not None and not args.journal:
         # Fail fast, before the (expensive) pipeline build.
         raise SystemExit("--snapshot-every-ms snapshots the journal: it "
                          "needs --journal")
+    mesh_spec = None
+    if args.mesh:
+        try:
+            # Parse before the pipeline build (fail fast on a typo); the
+            # device-count check happens when the engine builds the live
+            # mesh, after backend init.
+            mesh_spec = parse_mesh(args.mesh)
+        except ValueError as e:
+            raise SystemExit(str(e))
     # One serve run == one snapshot/event-log: reset before the pipeline
     # build so prewarm compiles and the queue/batcher/cache timelines are
     # all covered by the exported artifacts.
@@ -579,6 +588,7 @@ def cmd_serve(args) -> int:
                     degrade=degrade,
                     phase_pools=not args.single_pool,
                     phase2_max_batch=args.phase2_max_batch,
+                    mesh=mesh_spec,
                     flight=flight_tracer,
                     lifecycle=drain_ctl,
                     snapshot_every_ms=args.snapshot_every_ms,
@@ -840,6 +850,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "bucket above --max-batch — phase-2 lanes carry no "
                         "CFG uncond half, so 2x the lanes fit the same "
                         "peak footprint)")
+    s.add_argument("--mesh", default=None, metavar="dp=N",
+                   help="mesh-parallel serving: shard every dispatched "
+                        "batch over an N-device data-parallel mesh (lane "
+                        "buckets become per-device sub-batches; --max-batch "
+                        "and --phase2-max-batch keep their per-device "
+                        "meaning, so the global bucket set scales to "
+                        "N x {1,2,4,8}). N must be a power of two and at "
+                        "most the process's device count. dp=1 is bitwise-"
+                        "identical to serving without the flag; journal/"
+                        "drain/crash semantics are mesh-agnostic "
+                        "(docs/SERVING.md#mesh-parallel-serving)")
     s.add_argument("--single-pool", action="store_true",
                    help="disable phase-disaggregated continuous batching: "
                         "gated requests run their monolithic program in "

@@ -75,16 +75,28 @@ def _encode_jit(params, cfg, ids, dtype):
     return apply_text_encoder(params, cfg, ids, dtype=dtype)
 
 
-def stage_host(x):
-    """Explicitly stage a host value onto the default device — the h2d
-    form that passes ``jax.transfer_guard("disallow")``, which the serve
-    dispatch hot path runs under (tests/test_serve.py). On a multiprocess
-    mesh ``jax.device_put`` of an unsharded value runs a cross-host
-    equality collective the CPU backend can't execute, so multihost runs
-    keep the implicit path — the transfer-guard contract is a
-    single-process serving property."""
+def stage_host(x, mesh=None):
+    """Explicitly stage a host value onto the device(s) — the h2d form
+    that passes ``jax.transfer_guard("disallow")``, which the serve
+    dispatch hot path runs under (tests/test_serve.py).
+
+    ``mesh`` (a ``jax.sharding.Mesh``) stages the value *replicated over
+    the mesh* via an explicit ``NamedSharding`` — the mesh-dispatch form
+    of the same contract, so sharded serve programs receive their
+    host-born scalars (seeds, guidance) without an implicit per-device
+    broadcast (pinned under the virtual 8-device mesh by
+    tests/test_serve_mesh.py). On a *multiprocess* mesh ``jax.device_put``
+    of an unsharded value runs a cross-host equality collective the CPU
+    backend can't execute, so multihost runs keep the implicit path —
+    there the transfer-guard contract is explicitly out of scope
+    (single-process serving property; see ``parallel.sweep._stage_sharded``
+    for the collective-free multihost staging of *sharded* values)."""
     if jax.process_count() > 1:
         return jnp.asarray(x)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
     return jax.device_put(x)
 
 

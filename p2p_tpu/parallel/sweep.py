@@ -64,6 +64,18 @@ def _sweep_jit(
     return jax.vmap(one_group)(context, latents, controllers, uncond_per_step)
 
 
+def _stage_replicated(tree, mesh: Mesh):
+    """Stage a pytree's array leaves mesh-replicated — the explicit form
+    of what pjit would otherwise do *implicitly* at dispatch for shared
+    traced values (the schedule's constant tables). The tables are tiny
+    (a few (num_train,) vectors), so per-call staging is noise; what
+    matters is that the transfer is explicit and therefore passes the
+    serve layer's ``jax.transfer_guard("disallow")`` contract on mesh
+    dispatch."""
+    rep = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: _stage_sharded(x, rep), tree)
+
+
 def _stage_sharded(x, gspec: NamedSharding):
     """Put a host-replicated value onto the mesh under ``gspec``.
 
@@ -158,14 +170,16 @@ def sweep(
     # Explicit staging when the scale arrives as a host scalar: the serve
     # loop dispatches under jax.transfer_guard("disallow"), where an
     # implicit jnp.asarray(float) h2d would raise (already-on-device values
-    # pass through untouched).
+    # pass through untouched). On a mesh the scalar stages replicated
+    # under an explicit NamedSharding (same contract, mesh form).
     gs = (guidance_scale if isinstance(guidance_scale, jax.Array)
-          else stage_host(np.float32(guidance_scale)))
+          else stage_host(np.float32(guidance_scale), mesh=mesh))
 
     if mesh is not None:
         gspec = NamedSharding(mesh, P("dp"))
         context = _stage_sharded(context, gspec)
         latents = _stage_sharded(latents, gspec)
+        schedule = _stage_replicated(schedule, mesh)
         if controllers is not None:
             controllers = jax.tree_util.tree_map(
                 lambda x: _stage_sharded(x, gspec), controllers)
@@ -253,10 +267,10 @@ def _sweep_phase2_jit(
 
 
 def _phase_args(pipe, num_steps: int, scheduler: str, gate,
-                guidance_scale, layout, controllers):
+                guidance_scale, layout, controllers, mesh=None):
     """Shared wrapper plumbing for the two pool entry points: schedule,
     resolved+validated gate (a pool program needs both phases non-empty),
-    staged guidance, layout."""
+    staged guidance (replicated over ``mesh`` when given), layout."""
     cfg = pipe.config
     if layout is None:
         from ..models.config import unet_layout
@@ -271,7 +285,7 @@ def _phase_args(pipe, num_steps: int, scheduler: str, gate,
             f"{gate_step} of {num_scan} leaves a phase empty — ungated "
             "requests take the single-pool sweep() path")
     gs = (guidance_scale if isinstance(guidance_scale, jax.Array)
-          else stage_host(np.float32(guidance_scale)))
+          else stage_host(np.float32(guidance_scale), mesh=mesh))
     return cfg, layout, schedule, gate_step, gs
 
 
@@ -285,17 +299,28 @@ def sweep_phase1(
     guidance_scale: float = 7.5,
     scheduler: str = "ddim",
     layout: Optional[AttnLayout] = None,
+    mesh: Optional[Mesh] = None,
     gate=None,
     progress: bool = False,
     metrics: bool = False,
 ) -> PhaseCarry:
     """Run phase 1 of G groups (same shapes/semantics as :func:`sweep`) and
     return the hand-off carry instead of images. ``gate`` must resolve
-    strictly inside ``(0, S)``."""
+    strictly inside ``(0, S)``. ``mesh`` shards the group axis over ``dp``
+    exactly as in :func:`sweep` — the returned carry leaves come out
+    sharded the same way (the hand-off stays on device)."""
     cfg, layout, schedule, gate_step, gs = _phase_args(
         pipe, num_steps, scheduler, gate, guidance_scale, layout,
-        controllers)
+        controllers, mesh=mesh)
     warn_gate_truncation(gate_step, schedule.timesteps.shape[0], controllers)
+    if mesh is not None:
+        gspec = NamedSharding(mesh, P("dp"))
+        context = _stage_sharded(context, gspec)
+        latents = _stage_sharded(latents, gspec)
+        schedule = _stage_replicated(schedule, mesh)
+        if controllers is not None:
+            controllers = jax.tree_util.tree_map(
+                lambda x: _stage_sharded(x, gspec), controllers)
     from ..obs.spans import span
 
     with span("sampler.sweep_phase1", groups=int(context.shape[0]),
@@ -316,6 +341,7 @@ def sweep_phase2(
     guidance_scale: float = 7.5,
     scheduler: str = "ddim",
     layout: Optional[AttnLayout] = None,
+    mesh: Optional[Mesh] = None,
     gate=None,
     progress: bool = False,
     metrics: bool = False,
@@ -324,10 +350,24 @@ def sweep_phase2(
     ``controllers`` must already be the phase-2 slice
     (``engine.sampler.phase2_controller``, stacked over G — or None);
     passing a full edit controller here would silently split pools that
-    could share one program. Returns ``(images, final latents)``."""
+    could share one program. ``mesh`` shards the packed carry batch over
+    ``dp``: re-packed hand-off lanes (already on device, possibly from
+    different phase-1 batches on different shards) are staged to their
+    target shard with an explicit device-to-device ``device_put`` — no
+    host round-trip, so the transfer-guard("disallow") contract holds on
+    mesh dispatch too. Returns ``(images, final latents)``."""
     cfg, layout, schedule, gate_step, gs = _phase_args(
         pipe, num_steps, scheduler, gate, guidance_scale, layout,
-        controllers)
+        controllers, mesh=mesh)
+    if mesh is not None:
+        gspec = NamedSharding(mesh, P("dp"))
+        context_cond = _stage_sharded(context_cond, gspec)
+        carry = jax.tree_util.tree_map(
+            lambda x: _stage_sharded(x, gspec), carry)
+        schedule = _stage_replicated(schedule, mesh)
+        if controllers is not None:
+            controllers = jax.tree_util.tree_map(
+                lambda x: _stage_sharded(x, gspec), controllers)
     from ..obs.spans import span
 
     with span("sampler.sweep_phase2", groups=int(context_cond.shape[0]),

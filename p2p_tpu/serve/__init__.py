@@ -23,6 +23,7 @@ from .faults import InjectedFault, RetryPolicy, WatchdogTimeout, classify
 from .handoff import HandoffEntry
 from .journal import Journal, ReplayState, replay
 from .lifecycle import DrainController, signal_drain
+from .meshing import MeshSpec, parse_mesh
 from .programs import ProgramCache
 from .queue import AdmissionQueue, Rejected
 from .request import Cancel, Request, parse_jsonl_line, prepare
@@ -38,6 +39,7 @@ __all__ = [
     "HandoffEntry",
     "InjectedFault",
     "Journal",
+    "MeshSpec",
     "ProgramCache",
     "Rejected",
     "ReplayState",
@@ -48,6 +50,7 @@ __all__ = [
     "bucket_for",
     "classify",
     "parse_jsonl_line",
+    "parse_mesh",
     "prepare",
     "replay",
     "serve_forever",

@@ -26,23 +26,27 @@ from .queue import Entry
 BUCKET_SIZES = (1, 2, 4, 8)
 
 
-def bucket_for(n: int, max_batch: int = BUCKET_SIZES[-1]) -> int:
+def bucket_for(n: int, max_batch: int = BUCKET_SIZES[-1],
+               sizes: Tuple[int, ...] = BUCKET_SIZES) -> int:
     """Smallest fixed bucket holding ``n`` lanes (≤ ``max_batch``).
 
-    ``max_batch`` must itself be one of :data:`BUCKET_SIZES`: a cap between
-    buckets (say 5) would force a 5-entry flush into a 4-lane bucket,
-    silently breaking the every-entry-gets-a-lane padding contract and the
-    bounded-program-count guarantee built on it.
+    ``max_batch`` must itself be one of ``sizes``: a cap between buckets
+    (say 5) would force a 5-entry flush into a 4-lane bucket, silently
+    breaking the every-entry-gets-a-lane padding contract and the
+    bounded-program-count guarantee built on it. ``sizes`` defaults to the
+    single-device :data:`BUCKET_SIZES`; mesh serving passes the dp-scaled
+    set (``serve.meshing.scaled_bucket_sizes``) so every bucket splits
+    into whole per-device sub-batches.
     """
     if n < 1:
         raise ValueError(f"bucket_for needs n >= 1, got {n}")
-    if max_batch not in BUCKET_SIZES:
-        raise ValueError(f"max_batch must be one of {BUCKET_SIZES}, "
+    if max_batch not in sizes:
+        raise ValueError(f"max_batch must be one of {sizes}, "
                          f"got {max_batch}")
-    for b in BUCKET_SIZES:
+    for b in sizes:
         if b >= min(n, max_batch):
             return b
-    return BUCKET_SIZES[-1]
+    return sizes[-1]
 
 
 @dataclasses.dataclass
@@ -74,10 +78,12 @@ class DynamicBatcher:
 
     def __init__(self, max_batch: int = 8, max_wait_ms: float = 50.0,
                  key_fn: Optional[Callable[[Entry], Tuple]] = None,
-                 pool: str = "main"):
-        if max_batch not in BUCKET_SIZES:
+                 pool: str = "main",
+                 bucket_sizes: Tuple[int, ...] = BUCKET_SIZES):
+        if max_batch not in bucket_sizes:
             raise ValueError(
-                f"max_batch must be one of {BUCKET_SIZES}, got {max_batch}")
+                f"max_batch must be one of {bucket_sizes}, got {max_batch}")
+        self.bucket_sizes = tuple(bucket_sizes)
         self.max_batch = max_batch
         self.max_wait_ms = float(max_wait_ms)
         self.key_fn = key_fn or _default_key

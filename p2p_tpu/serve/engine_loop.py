@@ -126,6 +126,23 @@ additionally compacts the WAL periodically on the virtual clock, so a
 restart replays O(traffic since the last snapshot) instead of O(process
 history) and resumes the snapshot's degradation level. With all three
 off (the default), not a record, journal byte or program changes.
+
+**Mesh-parallel serving** (``mesh='dp=N'``, ``serve.meshing``): the
+engine goes mesh-native without changing its control flow. Lane buckets
+scale to per-device sub-batches (``BUCKET_SIZES · dp`` — a dispatched
+bucket lands as whole lanes per device under a ``NamedSharding`` on the
+group axis), the device count and mesh shape join every program-cache
+key, both phase pools dispatch sharded (phase 2's wide cheap batches are
+exactly the pool that spans devices: its cap scales to
+``phase2_max_batch · dp``), and hand-off carries are staged to their
+target shard device-to-device — the transfer-guard("disallow") contract
+holds on mesh dispatch too. Durability and determinism are mesh-agnostic
+by construction: the journal, snapshots, drain and crash-resume paths
+carry no device topology, so every drill passes unchanged at any ``dp``
+and a WAL written on one mesh shape restarts on another. ``dp=1`` is
+bitwise-identical to ``mesh=None`` (quality-gate ``mesh_parity``); the
+summary gains a ``mesh`` block and the registry per-device
+``serve_mesh_lanes_total`` only when a mesh is active.
 """
 
 from __future__ import annotations
@@ -142,6 +159,7 @@ from . import chaos as chaos_mod
 from . import faults as faults_mod
 from . import handoff as handoff_mod
 from . import lifecycle as lifecycle_mod
+from . import meshing as meshing_mod
 from . import queue as queue_mod
 from .batcher import BUCKET_SIZES, Batch, DynamicBatcher, bucket_for
 from .faults import RetryPolicy
@@ -239,13 +257,14 @@ class _Trace:
         return getattr(self._next, "arrival_ms", self._last_arrival)
 
 
-def _pick_bucket(n: int, compile_key, max_batch: int,
-                 cache: ProgramCache) -> int:
+def _pick_bucket(n: int, compile_key, max_batch: int, cache: ProgramCache,
+                 sizes=BUCKET_SIZES) -> int:
     """Smallest bucket that fits — unless a larger bucket for the same
     compile key is already warm, in which case pad up to it: a few wasted
-    lanes beat compiling (and caching) one more program."""
-    smallest = bucket_for(n, max_batch)
-    for b in BUCKET_SIZES:
+    lanes beat compiling (and caching) one more program. ``sizes`` is the
+    engine's active bucket set (the dp-scaled one under a mesh)."""
+    smallest = bucket_for(n, max_batch, sizes)
+    for b in sizes:
         if b >= smallest and b <= max_batch and (compile_key, b) in cache:
             return b
     return smallest
@@ -255,7 +274,9 @@ def _shrunken_bucket(max_batch: int, floor: int) -> int:
     """One fixed bucket below ``max_batch``, floored at ``floor`` — the
     level-2 degradation target. Degradation must never *raise* the
     operator's cap, so a floor above ``max_batch`` clamps back to it
-    (level 2 becomes a no-op rather than a grow)."""
+    (level 2 becomes a no-op rather than a grow). Operates on the
+    per-device :data:`BUCKET_SIZES`; the engine scales the result by the
+    mesh width, so a mesh degrades per-device like a single chip."""
     idx = BUCKET_SIZES.index(max_batch)
     return min(max_batch, max(floor, BUCKET_SIZES[max(0, idx - 1)]))
 
@@ -293,6 +314,7 @@ def serve_forever(
     lifecycle=None,
     snapshot_every_ms: Optional[float] = None,
     drain_timeout_ms: Optional[float] = None,
+    mesh=None,
 ) -> Iterator[dict]:
     """Drain ``requests`` (Request/Cancel objects or JSONL-shaped dicts,
     sorted by ``arrival_ms``) through the queue → batcher → program-cache →
@@ -346,24 +368,62 @@ def serve_forever(
     snapshot); a warm restart also resumes the snapshot's degradation
     level. All three default off and, off, change nothing (the
     disabled-mode parity contract).
+
+    ``mesh`` (None | ``'dp=N'`` | ``serve.meshing.MeshSpec``) makes the
+    engine mesh-native: lane buckets scale to per-device sub-batches
+    (``BUCKET_SIZES · dp`` — ``max_batch``/``phase2_max_batch`` keep their
+    per-device meaning), every dispatch runs the sharded sweep under a
+    ``NamedSharding`` on the group axis, and the device count + mesh
+    shape join the program-cache key (``meshing.mesh_key``). Durability
+    and determinism are mesh-agnostic: the journal carries no device
+    topology, so chaos/crash/drain/restart semantics are unchanged at any
+    ``dp`` — and a journal written on one mesh shape restarts on another.
+    ``dp=1`` is bitwise-identical to ``mesh=None``; ``dp>1`` matches at
+    the repo's documented vmap tolerance (tests/test_serve_mesh.py,
+    quality-gate ``mesh_parity``).
     """
     from ..engine.sampler import lane_select
     from ..utils import progress as progress_mod
 
+    # Mesh resolution first: the default runner factory and both batchers
+    # are shaped by it. mesh=None keeps every value identical to the
+    # pre-mesh engine (dp=1, the un-scaled bucket set, un-suffixed keys).
+    mesh_spec = meshing_mod.as_spec(mesh)
+    dp = 1 if mesh_spec is None else mesh_spec.dp
+    jmesh = None if mesh_spec is None else meshing_mod.build_mesh(mesh_spec)
+    sizes = (BUCKET_SIZES if mesh_spec is None
+             else meshing_mod.scaled_bucket_sizes(dp))
+
+    def mkey(key):
+        """Program-cache key for one dispatch: the mesh shape joins it so
+        a mesh program can never be served to a differently-shaped mesh
+        (cache poisoning by topology)."""
+        return key if mesh_spec is None else meshing_mod.mesh_key(
+            key, mesh_spec)
+
     make_runner = runner_factory or default_runner_factory(
         pipe, progress=progress, validate=validate_outputs,
-        heartbeat=watchdog_ms is not None)
+        heartbeat=watchdog_ms is not None, mesh=jmesh)
     policy = retry_policy or RetryPolicy()
     queue = AdmissionQueue(queue_cap)
-    batcher = DynamicBatcher(max_batch=max_batch, max_wait_ms=max_wait_ms)
+    if max_batch not in BUCKET_SIZES:
+        # Validate the PER-DEVICE knob before scaling: the batcher would
+        # reject max_batch*dp anyway, but its message would cite dp-scaled
+        # numbers the operator never typed (and could list their actual
+        # input as "valid").
+        raise ValueError(f"max_batch must be one of {BUCKET_SIZES}, "
+                         f"got {max_batch}")
+    batcher = DynamicBatcher(max_batch=max_batch * dp,
+                             max_wait_ms=max_wait_ms, bucket_sizes=sizes)
     if phase2_max_batch is None:
         phase2_max_batch = _wider_bucket(max_batch)
     elif phase2_max_batch not in BUCKET_SIZES:
         raise ValueError(f"phase2_max_batch must be one of {BUCKET_SIZES}, "
                          f"got {phase2_max_batch}")
     batcher2 = DynamicBatcher(
-        max_batch=phase2_max_batch, max_wait_ms=max_wait_ms,
-        key_fn=lambda e: e.prepared.phase2_batch_key, pool="phase2")
+        max_batch=phase2_max_batch * dp, max_wait_ms=max_wait_ms,
+        key_fn=lambda e: e.prepared.phase2_batch_key, pool="phase2",
+        bucket_sizes=sizes)
     # The cache shares the loop's retry policy: transient *build* failures
     # (prewarm and in-band misses) back off on the wall clock inside the
     # cache; execution faults stay classified at dispatch and back off on
@@ -444,9 +504,15 @@ def serve_forever(
             "serve_request_total_ms", "arrival -> images latency",
             labels=("phase",)),
     }
+    # Occupancy buckets span the dp-SCALED lane sizes up to the 8-chip
+    # ROADMAP target (dp>8 overflows the top bucket), and are the same
+    # fixed tuple for every run: the registry's families are process-wide,
+    # so a per-dp tuple would conflict when one process serves at two mesh
+    # shapes (the bench serve.mesh A/B does exactly that).
     m_occupancy = reg.histogram(
         "serve_batch_occupancy", "real lanes per dispatched batch",
-        buckets=tuple(float(b) for b in BUCKET_SIZES), labels=("phase",))
+        buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
+        labels=("phase",))
     m_handoffs = reg.counter(
         "serve_handoffs_total",
         "gated requests handed off from the phase-1 to the phase-2 pool")
@@ -501,6 +567,29 @@ def serve_forever(
         "serve_draining", "1 while the graceful-drain protocol is active")
     m_drains = reg.counter(
         "serve_drains_total", "graceful-drain protocol entries")
+    # Mesh families are created (and observed) only when a mesh is active:
+    # a mesh-less run's registry snapshot carries no mesh rows at all
+    # (the record stream / journal / program halves of disabled-mode
+    # parity are pinned by tests; the occupancy histogram's wider fixed
+    # bucket set above is the one deliberate registry-schema change).
+    if jmesh is not None:
+        m_mesh_devices = reg.gauge(
+            "serve_mesh_devices", "devices on the serve mesh's dp axis")
+        m_mesh_devices.set(dp)
+        m_mesh_lanes = reg.counter(
+            "serve_mesh_lanes_total",
+            "padded lanes dispatched per mesh device (bucket/dp each)",
+            labels=("device",))
+        _mesh_dev_ids = [str(d.id) for d in jmesh.devices.flat]
+
+    def note_mesh_dispatch(bucket: int) -> None:
+        """Per-device lane accounting for one successful dispatch: every
+        device ran exactly bucket/dp lanes (whole per-device sub-batches
+        by construction of the scaled bucket set)."""
+        if jmesh is None:
+            return
+        for did in _mesh_dev_ids:
+            m_mesh_lanes.labels(device=did).inc(bucket // dp)
 
     def record(status: str, request_id: str, *, release: bool = True,
                journal_write: bool = True, stage_phase: Optional[str] = "mono",
@@ -728,15 +817,18 @@ def serve_forever(
                 entry = queue_mod.Entry(prepared=prep, arrival_ms=0.0)
                 if prep.gated and phase_pools:
                     # A gated request compiles into TWO pool programs;
-                    # warm both at their pools' max buckets so neither
-                    # phase pays a compile in-band.
-                    keys = ((prep.phase1_key, bucket_for(max_batch,
-                                                         max_batch)),
-                            (prep.phase2_key, bucket_for(phase2_max_batch,
-                                                         phase2_max_batch)))
+                    # warm both at their pools' max (mesh-scaled) buckets
+                    # so neither phase pays a compile in-band.
+                    keys = ((mkey(prep.phase1_key),
+                             bucket_for(batcher.max_batch,
+                                        batcher.max_batch, sizes)),
+                            (mkey(prep.phase2_key),
+                             bucket_for(batcher2.max_batch,
+                                        batcher2.max_batch, sizes)))
                 else:
-                    keys = ((prep.compile_key, bucket_for(max_batch,
-                                                          max_batch)),)
+                    keys = ((mkey(prep.compile_key),
+                             bucket_for(batcher.max_batch,
+                                        batcher.max_batch, sizes)),)
                 for key, bucket in keys:
                     cache.get((key, bucket),
                               lambda k=key, b=bucket, e=entry: _build(
@@ -888,10 +980,10 @@ def serve_forever(
         batch_index += 1
         this_batch = batch_index
         guidance = live[0].request.guidance
-        compile_key = live[0].prepared.compile_key
+        compile_key = mkey(live[0].prepared.compile_key)
         bucket = _pick_bucket(len(live), compile_key, batcher.max_batch,
-                              cache)
-        if bucket > bucket_for(len(live), batcher.max_batch):
+                              cache, sizes)
+        if bucket > bucket_for(len(live), batcher.max_batch, sizes):
             m_upsized.inc()  # warm-preference padded past the smallest fit
         if journal is not None:
             journal.dispatched([e.request_id for e in live], this_batch,
@@ -989,6 +1081,7 @@ def serve_forever(
         # batch contributes to neither — its lanes re-dispatch via
         # isolate()).
         m_occupancy.labels(phase="mono").observe(float(len(live)))
+        note_mesh_dispatch(bucket)
         batch_hits.append(hit)
         bad = set()
         if finite is not None:
@@ -1033,7 +1126,8 @@ def serve_forever(
         for idx, e in enumerate(entries):
             batch_index += 1
             m_isolated.inc()
-            bucket = _pick_bucket(1, compile_key, batcher.max_batch, cache)
+            bucket = _pick_bucket(1, compile_key, batcher.max_batch, cache,
+                                  sizes)
             if journal is not None:
                 journal.dispatched([e.request_id], batch_index, vnow)
             dispatch_ms = vnow
@@ -1095,6 +1189,7 @@ def serve_forever(
             occupancies.append(1)
             # success-only, mirroring dispatch()
             m_occupancy.labels(phase="mono").observe(1.0)
+            note_mesh_dispatch(bucket)
             batch_hits.append(hit)
             if ((finite is not None and not bool(finite[0])) or
                     (fault is not None and fault.kind == "nan"
@@ -1187,10 +1282,10 @@ def serve_forever(
         batch_index += 1
         this_batch = batch_index
         guidance = live[0].request.guidance
-        compile_key = live[0].prepared.phase1_key
+        compile_key = mkey(live[0].prepared.phase1_key)
         bucket = _pick_bucket(len(live), compile_key, batcher.max_batch,
-                              cache)
-        if bucket > bucket_for(len(live), batcher.max_batch):
+                              cache, sizes)
+        if bucket > bucket_for(len(live), batcher.max_batch, sizes):
             m_upsized.inc()
         if journal is not None:
             journal.dispatched([e.request_id for e in live], this_batch,
@@ -1281,6 +1376,7 @@ def serve_forever(
         occupancies.append(len(live))
         occ_by_phase["phase1"].append(len(live))
         m_occupancy.labels(phase="phase1").observe(float(len(live)))
+        note_mesh_dispatch(bucket)
         batch_hits.append(hit)
         do_handoff(live, carry_g, this_batch, bucket, len(live),
                    dispatch_ms, compile_ms, run_ms, hit, fault=fault)
@@ -1294,7 +1390,8 @@ def serve_forever(
         for idx, e in enumerate(entries):
             batch_index += 1
             m_isolated.inc()
-            bucket = _pick_bucket(1, compile_key, batcher.max_batch, cache)
+            bucket = _pick_bucket(1, compile_key, batcher.max_batch, cache,
+                                  sizes)
             if journal is not None:
                 journal.dispatched([e.request_id], batch_index, vnow,
                                    phase=1)
@@ -1350,6 +1447,7 @@ def serve_forever(
             occupancies.append(1)
             occ_by_phase["phase1"].append(1)
             m_occupancy.labels(phase="phase1").observe(1.0)
+            note_mesh_dispatch(bucket)
             batch_hits.append(hit)
             do_handoff([e], carry_g, batch_index, bucket, 1, dispatch_ms,
                        compile_ms, run_ms, hit, isolated=True, fault=fault)
@@ -1420,10 +1518,10 @@ def serve_forever(
         batch_index += 1
         this_batch = batch_index
         guidance = live[0].request.guidance
-        compile_key = live[0].prepared.phase2_key
+        compile_key = mkey(live[0].prepared.phase2_key)
         bucket = _pick_bucket(len(live), compile_key, batcher2.max_batch,
-                              cache)
-        if bucket > bucket_for(len(live), batcher2.max_batch):
+                              cache, sizes)
+        if bucket > bucket_for(len(live), batcher2.max_batch, sizes):
             m_upsized.inc()
         if journal is not None:
             journal.dispatched([e.request_id for e in live], this_batch,
@@ -1516,6 +1614,7 @@ def serve_forever(
         occupancies.append(len(live))
         occ_by_phase["phase2"].append(len(live))
         m_occupancy.labels(phase="phase2").observe(float(len(live)))
+        note_mesh_dispatch(bucket)
         batch_hits.append(hit)
         bad = set()
         if finite is not None:
@@ -1551,7 +1650,8 @@ def serve_forever(
         for idx, e in enumerate(entries):
             batch_index += 1
             m_isolated.inc()
-            bucket = _pick_bucket(1, compile_key, batcher2.max_batch, cache)
+            bucket = _pick_bucket(1, compile_key, batcher2.max_batch, cache,
+                                  sizes)
             if journal is not None:
                 journal.dispatched([e.request_id], batch_index, vnow,
                                    phase=2)
@@ -1607,6 +1707,7 @@ def serve_forever(
             occupancies.append(1)
             occ_by_phase["phase2"].append(1)
             m_occupancy.labels(phase="phase2").observe(1.0)
+            note_mesh_dispatch(bucket)
             batch_hits.append(hit)
             if ((finite is not None and not bool(finite[0])) or
                     e.nan_injected or
@@ -1674,15 +1775,17 @@ def serve_forever(
     def _apply_degrade_level() -> None:
         # Level 2+: smaller flush/padding bucket — shorter head-of-line
         # blocking when deadlines are the binding constraint. The batcher
-        # caps stay within BUCKET_SIZES, preserving the padding contract.
-        # Degradation is per-pool: both pools shrink one step below their
-        # own cap, so the phase-2 pool keeps its relative width.
+        # caps stay within the fixed bucket set, preserving the padding
+        # contract. Degradation is per-pool: both pools shrink one step
+        # below their own cap, so the phase-2 pool keeps its relative
+        # width. On a mesh the shrink happens per device (the operator
+        # knobs' unit) and scales back up by dp.
         shrink = degrade_level >= 2
         batcher.max_batch = (_shrunken_bucket(max_batch, degrade.min_bucket)
-                             if shrink else max_batch)
+                             if shrink else max_batch) * dp
         batcher2.max_batch = (
             _shrunken_bucket(phase2_max_batch, degrade.min_bucket)
-            if shrink else phase2_max_batch)
+            if shrink else phase2_max_batch) * dp
 
     if restore_degrade_level:
         # Warm restart: resume the snapshot's degradation level instead of
@@ -1985,7 +2088,19 @@ def serve_forever(
             "phase2": {**_pool(occ_by_phase["phase2"]),
                        "pack_p50": _percentile(
                            sorted(occ_by_phase["phase2"]), 50)},
-            "phase2_max_batch": phase2_max_batch,
+            # The pool's global lane cap: per-device knob × mesh width
+            # (identical to the knob itself off-mesh / at dp=1).
+            "phase2_max_batch": phase2_max_batch * dp,
+        }
+    if jmesh is not None:
+        # Present only when a mesh is active, so the mesh-less summary
+        # stays byte-identical (disabled-mode parity). Topology lives
+        # HERE, in the ephemeral summary — never in the journal.
+        summary["mesh"] = {
+            "dp": dp,
+            "devices": [int(d) for d in _mesh_dev_ids],
+            "max_batch_per_device": max_batch,
+            "phase2_max_batch_per_device": phase2_max_batch,
         }
     if replay_info is not None:
         summary["replay"] = replay_info

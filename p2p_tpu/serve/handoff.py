@@ -91,18 +91,48 @@ def lane_carries(carry: Any, n: int) -> List[Any]:
             for i in range(n)]
 
 
-def stack_carries(carries: List[Any], bucket: int) -> Any:
+def stack_carries(carries: List[Any], bucket: int, mesh=None) -> Any:
     """Re-pack per-lane carries into a phase-2 batch of ``bucket`` lanes,
     replicating the last real carry into the padding lanes (the same
     padding contract as the input batcher: padded lanes are masked out of
-    results by ``lane_select``)."""
+    results by ``lane_select``).
+
+    ``mesh``: on a device mesh the lanes being packed may live on
+    *different* shards (they came out of different phase-1 batches, each
+    sharded over ``dp``), and ``jnp.stack`` refuses cross-committed
+    operands. Each lane is staged straight to its TARGET device
+    (explicit device-to-device ``device_put`` — no host round-trip), the
+    per-device sub-batches are stacked locally, and the global
+    ``P("dp")``-sharded batch is assembled from the shards. No device
+    ever holds more than its own ``bucket/dp`` lanes — replicating the
+    lanes first would transiently put the whole global batch (carry +
+    AttnCache) on every chip, defeating the per-device footprint cap the
+    dp-scaled phase-2 width exists to honor."""
     import jax
     import jax.numpy as jnp
 
     carries = list(carries)
     while len(carries) < bucket:
         carries.append(carries[-1])
-    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *carries)
+    if mesh is None:
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *carries)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    devices = list(mesh.devices.flat)
+    per_dev = bucket // len(devices)  # whole lanes by bucket construction
+    gspec = NamedSharding(mesh, PartitionSpec("dp"))
+
+    def pack(*xs):
+        shards = []
+        for i, d in enumerate(devices):
+            block = [jax.device_put(x, d)
+                     for x in xs[i * per_dev:(i + 1) * per_dev]]
+            shards.append(jnp.stack(block))  # stays on d: all operands on d
+        global_shape = (bucket,) + tuple(xs[0].shape)
+        return jax.make_array_from_single_device_arrays(
+            global_shape, gspec, shards)
+
+    return jax.tree_util.tree_map(pack, *carries)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,149 @@
+"""Mesh-parallel serving: the spec, bucket scaling, and program-key rules.
+
+``serve --mesh dp=N`` puts the engine's *batch dimension* on a device mesh
+(ROADMAP open item 1; the hardware-co-optimization axis SD-Acc pairs with
+its phase-aware sampling). The serve loop stays a single-threaded
+virtual-clock scheduler — what changes is the shape of a dispatch:
+
+- **Lane buckets become per-device sub-batches.** The fixed padding set
+  (:data:`~p2p_tpu.serve.batcher.BUCKET_SIZES`) scales to
+  ``(dp, 2·dp, 4·dp, 8·dp)``: a dispatched bucket of ``b·dp`` lanes lands
+  as ``b`` whole lanes per device under a ``NamedSharding`` on the group
+  axis (``PartitionSpec("dp")`` — the SNIPPETS [2]/[3] pattern via
+  ``parallel.mesh.make_mesh``). ``--max-batch`` keeps its per-device
+  meaning, so one operator knob describes one device's footprint on any
+  mesh; the phase-2 pool's wider cap scales the same way
+  (``phase2_max_batch · dp`` — the equal-footprint doubling now spans the
+  whole mesh).
+- **Program-cache entries become mesh programs.** The device count and
+  mesh shape join the cache/compile key (:func:`mesh_key`), so a
+  ``dp=4`` program can never be served to a ``dp=1`` dispatch (or
+  vice versa) out of the LRU or the persistent compile cache. Prewarm
+  builds the mesh programs ahead of traffic exactly like today.
+- **Durability stays mesh-agnostic.** Nothing in this module touches the
+  journal: the WAL, snapshots, hand-off spills, drain and crash-resume
+  paths carry request state only, never device topology — a journal
+  written at ``dp=4`` restarts cleanly at ``dp=1`` and the other way
+  round (pinned by tests/test_serve_mesh.py).
+
+``dp=1`` builds a real one-device mesh and dispatches through the sharded
+staging path, bitwise-identical to the mesh-less engine (the
+``mesh_parity`` quality-gate leg); ``dp>1`` matches at the repo's
+documented vmap tolerance (±1 uint8 step, tests/test_parallel.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple, Union
+
+from .batcher import BUCKET_SIZES
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """The serve engine's mesh request: a data-parallel width. Kept
+    jax-free (CLI parsing and key derivation must not initialize a
+    backend); :func:`build_mesh` turns it into a live ``jax.sharding.Mesh``
+    when the engine starts."""
+
+    dp: int = 1
+
+    def __post_init__(self):
+        if self.dp < 1:
+            raise ValueError(f"mesh dp must be >= 1, got {self.dp}")
+        if self.dp & (self.dp - 1):
+            # Power-of-two dp keeps every scaled bucket divisible by dp
+            # (and the per-device sub-batch a whole fixed bucket).
+            raise ValueError(f"mesh dp must be a power of two, got {self.dp}")
+
+
+def parse_mesh(spec: str) -> MeshSpec:
+    """Parse the CLI ``--mesh`` value: ``dp=N`` (the only axis the serve
+    engine shards today — tensor parallelism composes later via
+    ``parallel.mesh`` tp rules)."""
+    s = spec.strip()
+    if not s.startswith("dp="):
+        raise ValueError(f"--mesh expects 'dp=N', got {spec!r}")
+    try:
+        dp = int(s[3:])
+    except ValueError:
+        raise ValueError(f"--mesh expects an integer dp, got {spec!r}")
+    return MeshSpec(dp=dp)
+
+
+def as_spec(mesh: Union[None, str, MeshSpec]) -> Optional[MeshSpec]:
+    """Normalize the engine's ``mesh=`` argument (None | 'dp=N' | MeshSpec)."""
+    if mesh is None or isinstance(mesh, MeshSpec):
+        return mesh
+    if isinstance(mesh, str):
+        return parse_mesh(mesh)
+    raise TypeError(f"mesh must be None, 'dp=N' or MeshSpec, got {mesh!r}")
+
+
+def build_mesh(spec: MeshSpec):
+    """A live ``(dp, tp=1)`` mesh over the first ``spec.dp`` devices
+    (``parallel.mesh.make_mesh``), validated against what the process
+    actually has — a mesh wider than the machine is a configuration error
+    at startup, never a shape failure mid-traffic."""
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    n = len(jax.devices())
+    if spec.dp > n:
+        raise ValueError(
+            f"--mesh dp={spec.dp} needs {spec.dp} devices; this process "
+            f"has {n} (virtual CPU meshes: "
+            f"--xla_force_host_platform_device_count)")
+    return make_mesh(spec.dp, tp=1)
+
+
+def replicate_pipeline(pipe, mesh):
+    """The mesh's weight residency: one explicit replication of the U-Net
+    and VAE params onto every mesh device at engine start. Without it,
+    every dispatch would *implicitly* reshard the device-0 weights onto
+    the mesh — a per-batch transfer the
+    ``jax.transfer_guard("disallow")`` contract exists to forbid (and the
+    mesh transfer-guard test catches). The text encoder stays put: it
+    runs host-side of the dispatch (admission-time prompt encoding), not
+    inside the sharded programs."""
+    import dataclasses as _dc
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    put = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+        lambda x: jax.device_put(x, rep), tree)
+    return _dc.replace(pipe, unet_params=put(pipe.unet_params),
+                       vae_params=put(pipe.vae_params))
+
+
+def scaled_bucket_sizes(dp: int) -> Tuple[int, ...]:
+    """The global lane-bucket set on a ``dp``-wide mesh: each fixed bucket
+    times ``dp``, so every padded batch splits into whole per-device
+    sub-batches and the bounded-program-count contract holds per mesh
+    shape (still exactly ``len(BUCKET_SIZES)`` buckets)."""
+    return tuple(b * dp for b in BUCKET_SIZES)
+
+
+#: Tag prefix of the mesh component appended to program-cache keys.
+MESH_KEY_TAG = "mesh"
+
+
+def mesh_key(compile_key: Tuple, spec: MeshSpec) -> Tuple:
+    """Join the device count / mesh shape to a program key: a mesh program
+    and its single-chip twin must never share a cache entry (LRU or the
+    persistent XLA cache keyed off the traced call)."""
+    return compile_key + ((MESH_KEY_TAG, "dp", spec.dp),)
+
+
+def strip_mesh_key(compile_key: Tuple) -> Tuple:
+    """Drop a trailing mesh component (no-op when absent) — runners parse
+    the un-suffixed key layout."""
+    if (compile_key and isinstance(compile_key[-1], tuple)
+            and len(compile_key[-1]) == 3
+            and compile_key[-1][0] == MESH_KEY_TAG):
+        return compile_key[:-1]
+    return compile_key

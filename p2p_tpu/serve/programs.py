@@ -142,13 +142,18 @@ class SweepRunner:
 
     def __init__(self, pipe, compile_key: Tuple, bucket: int,
                  progress: bool = False, validate: bool = False,
-                 heartbeat: bool = False):
+                 heartbeat: bool = False, mesh=None):
         self.pipe = pipe
         (_, self.steps, self.scheduler, self.gate_step, self.group_batch,
          _) = compile_key
         self.bucket = bucket
         self.progress = progress
         self.validate = validate
+        # A live jax.sharding.Mesh (or None): the sweep shards the lane
+        # axis over its dp axis. Inputs are still assembled on the default
+        # device; the sweep entry points stage them onto the mesh with
+        # explicit NamedShardings (transfer-guard-clean either way).
+        self.mesh = mesh
         # heartbeat=True traces the step callback in even when progress is
         # off (sweep's metrics flag: report=False, so nothing prints) —
         # the watchdog's liveness source must not depend on the operator
@@ -212,7 +217,7 @@ class SweepRunner:
 
         imgs, lats = sweep(self.pipe, ctx, lat, ctrl, num_steps=self.steps,
                            guidance_scale=guidance, scheduler=self.scheduler,
-                           mesh=None, gate=self.gate_step,
+                           mesh=self.mesh, gate=self.gate_step,
                            progress=self.progress, metrics=self.heartbeat)
         return imgs, lats
 
@@ -235,6 +240,28 @@ class SweepRunner:
         return jax.device_get(imgs)
 
 
+_COND_HALF_JIT = None
+
+
+def _cond_half(ctx, group_batch: int):
+    """``ctx[:, group_batch:]`` as a compiled program with a static start
+    index — transfer-free at execution, unlike the eager slice (whose
+    ``dynamic_slice`` impl stages the start index h2d per call). One
+    module-level jit wrapper so the program caches per (shape, start)."""
+    global _COND_HALF_JIT
+    if _COND_HALF_JIT is None:
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("b",))
+        def cut(x, b):
+            return x[:, b:]
+
+        _COND_HALF_JIT = cut
+    return _COND_HALF_JIT(ctx, b=group_batch)
+
+
 class Phase1Runner(SweepRunner):
     """Phase-1 POOL runner: the same inputs as a monolithic sweep (CFG
     context halves, shared-seed latents, full controller), but the program
@@ -245,18 +272,19 @@ class Phase1Runner(SweepRunner):
 
     def __init__(self, pipe, compile_key: Tuple, bucket: int,
                  progress: bool = False, validate: bool = False,
-                 heartbeat: bool = False):
+                 heartbeat: bool = False, mesh=None):
         # Strip the "phase1" pool tag; the rest is the monolithic key
         # layout SweepRunner already parses.
         super().__init__(pipe, compile_key[1:], bucket, progress=progress,
-                         validate=validate, heartbeat=heartbeat)
+                         validate=validate, heartbeat=heartbeat, mesh=mesh)
 
     def _run(self, ctx, lat, ctrl, guidance: float):
         from ..parallel.sweep import sweep_phase1
 
         return sweep_phase1(self.pipe, ctx, lat, ctrl, num_steps=self.steps,
                             guidance_scale=guidance,
-                            scheduler=self.scheduler, gate=self.gate_step,
+                            scheduler=self.scheduler, mesh=self.mesh,
+                            gate=self.gate_step,
                             progress=self.progress, metrics=self.heartbeat)
 
     def warm(self, entries) -> None:
@@ -276,8 +304,12 @@ class Phase1Runner(SweepRunner):
         # lane needs no encoder at all). Everything STAYS on device (only
         # a journal spill fetches it to host) — but the dispatch is
         # synchronized so run_ms measures execution, not async enqueue.
+        # The cond half is cut by a jitted slice with a STATIC start: an
+        # eager `ctx[:, b:]` stages its start index host→device on every
+        # dispatch (dynamic_slice's eager impl), which the mesh
+        # transfer-guard test caught in this previously-unguarded pool.
         return jax.block_until_ready(
-            {"carry": carry, "ctx": ctx[:, self.group_batch:]})
+            {"carry": carry, "ctx": _cond_half(ctx, self.group_batch)})
 
 
 class Phase2Runner:
@@ -294,7 +326,7 @@ class Phase2Runner:
 
     def __init__(self, pipe, compile_key: Tuple, bucket: int,
                  progress: bool = False, validate: bool = False,
-                 heartbeat: bool = False):
+                 heartbeat: bool = False, mesh=None):
         self.pipe = pipe
         (_, _, self.steps, self.scheduler, self.gate_step, self.group_batch,
          _) = compile_key
@@ -302,16 +334,26 @@ class Phase2Runner:
         self.progress = progress
         self.validate = validate
         self.heartbeat = heartbeat
+        self.mesh = mesh
         self.last_lane_finite = None
         self._expected_spec = None
 
     def _spec_for(self, prep) -> str:
+        import jax
+
         from ..engine.sampler import carry_spec
 
         from .handoff import carry_template
 
         if self._expected_spec is None:
-            self._expected_spec = carry_spec(carry_template(self.pipe, prep))
+            # Abstract evaluation only: the spec is a shape/dtype/treedef
+            # string, so materializing the template's zero arrays here
+            # would be pure waste — and its scalar constants would be
+            # *implicit* h2d transfers inside the guarded dispatch path
+            # (caught by the mesh transfer-guard test; carry_spec reads
+            # shapes/dtypes identically off ShapeDtypeStructs).
+            self._expected_spec = carry_spec(jax.eval_shape(
+                lambda: carry_template(self.pipe, prep)))
         return self._expected_spec
 
     def _inputs(self, entries, zeros: bool = False):
@@ -335,7 +377,9 @@ class Phase2Runner:
             ctrls.append(phase2_controller(e.prepared.controller))
         # Pack the hand-off units (sampler carry + encoded cond context)
         # into one phase-2 batch; padding replicates the last real lane.
-        packed = stack_carries(carries, self.bucket)
+        # On a mesh the lanes may live on different shards: stack_carries
+        # reconciles them device-to-device (no host round-trip).
+        packed = stack_carries(carries, self.bucket, mesh=self.mesh)
         ctx, carry = packed["ctx"], packed["carry"]
         while len(ctrls) < self.bucket:
             ctrls.append(ctrls[-1])
@@ -351,7 +395,8 @@ class Phase2Runner:
 
         return sweep_phase2(self.pipe, ctx, carry, ctrl,
                             num_steps=self.steps, guidance_scale=guidance,
-                            scheduler=self.scheduler, gate=self.gate_step,
+                            scheduler=self.scheduler, mesh=self.mesh,
+                            gate=self.gate_step,
                             progress=self.progress, metrics=self.heartbeat)
 
     def warm(self, entries) -> None:
@@ -390,15 +435,32 @@ class Phase2Runner:
 
 
 def default_runner_factory(pipe, progress: bool = False,
-                           validate: bool = False, heartbeat: bool = False):
+                           validate: bool = False, heartbeat: bool = False,
+                           mesh=None):
     """The engine's default ``runner_factory``: real sweeps on ``pipe``.
     Dispatches on the compile key's pool tag — ``("phase1", ...)`` /
     ``("phase2", ...)`` keys build the disaggregated pool runners,
     everything else the monolithic :class:`SweepRunner` (ungated traffic's
-    bitwise-unchanged fast path)."""
+    bitwise-unchanged fast path). ``mesh`` (a live ``jax.sharding.Mesh``)
+    makes every runner dispatch sharded over its dp axis; the engine
+    suffixes the cache key with the mesh shape (``serve.meshing.mesh_key``)
+    — stripped here, since the runners parse the un-suffixed layout."""
+
+    if mesh is not None:
+        # Weight residency: replicate the sweep-side params onto the mesh
+        # ONCE, so no dispatch ever pays (or implicitly performs) the
+        # device-0 → mesh reshard. Shared by every runner the factory
+        # builds.
+        from .meshing import replicate_pipeline
+
+        pipe = replicate_pipeline(pipe, mesh)
 
     def make(compile_key: Tuple, bucket: int):
-        kw = dict(progress=progress, validate=validate, heartbeat=heartbeat)
+        from .meshing import strip_mesh_key
+
+        compile_key = strip_mesh_key(compile_key)
+        kw = dict(progress=progress, validate=validate, heartbeat=heartbeat,
+                  mesh=mesh)
         tag = compile_key[0] if compile_key else None
         if tag == "phase1":
             return Phase1Runner(pipe, compile_key, bucket, **kw)

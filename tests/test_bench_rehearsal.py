@@ -59,13 +59,28 @@ SERVE_PHASES_KEYS = {
 }
 
 
+#: ISSUE 10: the serve block's `mesh` sub-record — the engine sharded over
+#: a dp device mesh at 10x loadgen traffic. Frozen literal so the schema
+#: cannot drift before the chip window measures the scaling claim: the
+#: devices axis, the per-device img/s, the dp=1 vs dp=N scaling ratio and
+#: the phase-2 pack width are exactly what the on-chip near-linear-scaling
+#: number is recorded from.
+SERVE_MESH_KEYS = {
+    "devices", "n_requests",
+    "dp1_makespan_ms", "mesh_makespan_ms",
+    "scaling_ratio", "imgs_per_s_per_device",
+    "phase2_pack_p50", "phase2_max_batch", "handoffs",
+}
+
+
 def test_rehearsal_schema_unchanged_by_static_analysis_pr():
-    """ISSUE 5 was a static-analysis PR and ISSUE 6 a serve-architecture
-    PR: the top-level rehearsal schema stays exactly the PR-4 set (ISSUE 6
-    grows the serve block's NESTED `phases` sub-record instead —
-    SERVE_PHASES_KEYS). A future PR that grows the schema updates the
-    frozen copies (and EXPECTED_KEYS, and bench._BLOCK_KEYS) in the same
-    diff, deliberately."""
+    """ISSUE 5 was a static-analysis PR, ISSUE 6 a serve-architecture PR
+    and ISSUE 10 a mesh-serving PR: the top-level rehearsal schema stays
+    exactly the PR-4 set (ISSUE 6 grows the serve block's NESTED `phases`
+    sub-record — SERVE_PHASES_KEYS — and ISSUE 10 its NESTED `mesh`
+    sub-record — SERVE_MESH_KEYS). A future PR that grows the schema
+    updates the frozen copies (and EXPECTED_KEYS, and bench._BLOCK_KEYS)
+    in the same diff, deliberately."""
     assert EXPECTED_KEYS == {
         "metric", "value", "unit", "vs_baseline", "variant", "platform",
         "single_group_imgs_per_s",
@@ -548,6 +563,21 @@ def test_bench_rehearsal_green_and_complete():
     assert ph["throughput_ratio"] > 0
     assert ph["single_pool_makespan_ms"] > 0
     assert ph["two_pool_makespan_ms"] > 0
+    # Mesh-parallel serving acceptance (ISSUE 10): the mesh leg ran on a
+    # real multi-device mesh (the rehearsal inherits the virtual 8-device
+    # CPU platform), crossed the hand-off, packed phase-2 lanes into the
+    # dp-scaled buckets, and recorded the devices axis + scaling keys the
+    # chip window will measure. Like the phases A/B, the CPU-rehearsal
+    # scaling ratio is recorded, not thresholded (linear batch cost).
+    mb = doc["serve"]["mesh"]
+    assert set(mb) == SERVE_MESH_KEYS
+    assert mb["devices"] >= 2            # the virtual mesh really spanned
+    assert mb["n_requests"] >= 12
+    assert mb["handoffs"] >= 1
+    assert mb["phase2_max_batch"] == 4 * mb["devices"]
+    assert mb["scaling_ratio"] > 0
+    assert mb["imgs_per_s_per_device"] > 0
+    assert mb["dp1_makespan_ms"] > 0 and mb["mesh_makespan_ms"] > 0
     # Resilience acceptance (ISSUE 4): the standard drill must actually
     # drill — faults fired and were retried, ok outputs stayed bitwise-
     # stable vs the fault-free run (run_drill raises otherwise, failing

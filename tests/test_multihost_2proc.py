@@ -138,6 +138,66 @@ _CPU_MULTIPROCESS_UNSUPPORTED = (
     "Multiprocess computations aren't implemented on the CPU backend")
 
 
+def test_single_process_virtual_mesh_dp_sweep():
+    """The 2-process worker's exact sweep, single-process on a virtual
+    dp=4 mesh — so the mesh staging/dispatch path (`sweep(mesh=...)`:
+    `_stage_sharded` device donation, the sharded `_sweep_jit` execution,
+    `process_groups` ownership arithmetic) runs in tier-1 on EVERY suite
+    run. The 2-proc test below is slow-marked AND xfailed on the baked
+    jaxlib's missing CPU multiprocess SPMD, which used to leave mesh
+    execution with zero always-on coverage; this lane is the same
+    workload minus the process boundary."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual multi-device CPU platform")
+
+    from p2p_tpu.controllers import factory
+    from p2p_tpu.engine.sampler import Pipeline, encode_prompts
+    from p2p_tpu.models import TINY, init_text_encoder, init_unet
+    from p2p_tpu.models import vae as vae_mod
+    from p2p_tpu.parallel import (make_mesh, process_groups, seed_latents,
+                                  sweep)
+    from p2p_tpu.utils.tokenizer import HashWordTokenizer
+
+    cfg = TINY
+    tok = HashWordTokenizer(model_max_length=cfg.text.max_length)
+    pipe = Pipeline(
+        config=cfg,
+        unet_params=init_unet(jax.random.PRNGKey(0), cfg.unet),
+        text_params=init_text_encoder(jax.random.PRNGKey(1), cfg.text),
+        vae_params=vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae),
+        tokenizer=tok)
+    prompts = ["a cat riding a bike", "a dog riding a bike"]
+    g = 4
+    mesh = make_mesh(g, tp=1)
+    ctrl = factory.attention_replace(
+        prompts, 2, cross_replace_steps=0.8, self_replace_steps=0.4,
+        tokenizer=tok, self_max_pixels=8 * 8, max_len=cfg.text.max_length)
+    ctrls = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x, (g,) + x.shape), ctrl)
+    cond = encode_prompts(pipe, prompts)
+    uncond = encode_prompts(pipe, [""] * len(prompts))
+    ctx = jnp.concatenate([uncond, cond], axis=0)
+    ctx = jnp.broadcast_to(ctx[None], (g,) + ctx.shape)
+    lats = seed_latents(jax.random.PRNGKey(3), g, len(prompts),
+                        pipe.latent_shape)
+    imgs, _ = sweep(pipe, ctx, lats, ctrls, num_steps=2, mesh=mesh)
+    assert imgs.shape == (g, len(prompts), cfg.image_size, cfg.image_size,
+                          3)
+    # The group axis is genuinely sharded: one whole group per device,
+    # and single-process ownership is the full group list.
+    assert len(imgs.addressable_shards) == g
+    assert {s.data.shape[0] for s in imgs.addressable_shards} == {1}
+    assert list(process_groups(g)) == [0, 1, 2, 3]
+    # Same math as the mesh-less engine, at the documented vmap tolerance.
+    want, _ = sweep(pipe, ctx, lats, ctrls, num_steps=2, mesh=None)
+    np.testing.assert_allclose(np.asarray(imgs, np.float32),
+                               np.asarray(want, np.float32), atol=1.0)
+
+
 @pytest.mark.slow
 def test_two_process_dp_sweep(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -47,6 +47,8 @@ HEADLINE_KEYS: Tuple[Tuple[str, str, str], ...] = (
     ("phase2_ms_per_step", "ms/step", "lower"),
     ("serve.p95_ms", "ms", "lower"),
     ("serve.phases.two_pool_p95_ms", "ms", "lower"),
+    ("serve.mesh.imgs_per_s_per_device", "img/s/device", "higher"),
+    ("serve.mesh.scaling_ratio", "x", "higher"),
     ("obs.overhead_pct", "%", "lower"),
     ("nullinv_s_per_image", "s/image", "lower"),
 )

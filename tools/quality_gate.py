@@ -26,9 +26,15 @@ import time
 
 # Force the deterministic CPU backend before any jax import: quality is
 # platform-independent, and the goldens are pinned on CPU (same scrub the
-# test conftest applies).
+# test conftest applies). The virtual 8-device platform (same flag as the
+# conftest) gives the mesh_parity check a real mesh to span; it changes
+# nothing for the single-device checks (device 0 numerics are identical).
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=8").strip()
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
@@ -123,6 +129,81 @@ def _serve_parity():
                    - np.asarray(want).astype(np.int16))
         worst = max(worst, int(d.max()))
     return worst
+
+
+def _mesh_parity():
+    """The mesh-parallel serving contract (ISSUE 10), two legs on the
+    virtual 8-device mesh:
+
+    1. **dp=1 bitwise** — ``--mesh dp=1`` must be bitwise-identical to the
+       mesh-less engine: record stream byte-for-byte (zero-timer, images
+       and the summary's mesh block stripped) and images bit-for-bit. The
+       one-device mesh still takes the sharded staging/dispatch path, so
+       this pins the whole mesh machinery as numerics-neutral.
+    2. **gated dp=4 chaos drill** — the standard seeded gate-mix drill
+       (faults, cancels, crash-replay) through a dp=4 mesh, unchanged:
+       exactly-once terminals, ok-outputs bitwise-identical to the
+       fault-free mesh run, hand-offs actually crossing the sharded
+       pools. Durability must be mesh-agnostic — the drill's journal
+       carries no topology, so this leg runs ``run_drill`` verbatim with
+       only ``serve_kw={"mesh": ...}`` added.
+
+    Returns (records_identical, images_identical, dp4_ok, handoffs,
+    resumed)."""
+    import importlib.util
+    import json
+
+    import jax
+    import numpy as np
+
+    from p2p_tpu.models import TINY
+    from p2p_tpu.serve import MeshSpec, Request, serve_forever
+    from tests.test_golden import _pipe
+
+    pipe = _pipe(TINY)
+    prompts = ["a squirrel eating a burger", "a squirrel eating a lasagna"]
+    reqs = [Request(request_id="mp-gated", prompt=prompts[0],
+                    target=prompts[1], mode="replace", steps=3, seed=42,
+                    gate=0.5, arrival_ms=0.0),
+            Request(request_id="mp-plain", prompt=prompts[0], steps=3,
+                    seed=7, arrival_ms=1.0)]
+
+    def run(mesh):
+        recs = list(serve_forever(pipe, list(reqs), max_batch=4,
+                                  max_wait_ms=1.0, timer=lambda: 0.0,
+                                  mesh=mesh))
+        imgs = {r["request_id"]: r["images"] for r in recs
+                if r["status"] == "ok"}
+        stripped = [{k: v for k, v in r.items()
+                     if k not in ("images", "mesh")} for r in recs]
+        return json.dumps(stripped, sort_keys=True), imgs
+
+    base_bytes, base_imgs = run(None)
+    dp1_bytes, dp1_imgs = run(MeshSpec(dp=1))
+    records_identical = base_bytes == dp1_bytes
+    images_identical = (set(base_imgs) == set(dp1_imgs) and all(
+        np.array_equal(base_imgs[k], dp1_imgs[k]) for k in base_imgs))
+
+    # dp4_ok None = leg skipped (the operator pinned XLA_FLAGS to a
+    # smaller virtual platform, so the file-top 8-device default never
+    # applied): not a drift — the gate's own default environment always
+    # runs it.
+    dp4_ok, handoffs, resumed = None, 0, 0
+    if len(jax.devices()) >= 4:
+        spec = importlib.util.spec_from_file_location(
+            "p2p_chaos_drill", os.path.join(_REPO, "tools",
+                                            "chaos_drill.py"))
+        drill = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(drill)
+        gtrace, gplan = drill.standard_trace(gate_mix="0.5:3,off:1")
+        res = drill.run_drill(drill.tiny_pipeline(), gtrace, gplan,
+                              crash_after=8, warmup=True,
+                              serve_kw={"mesh": MeshSpec(dp=4)})
+        handoffs = res.get("handoffs", 0)
+        resumed = res["crash_replay"]["resumed_handoffs"]
+        dp4_ok = (handoffs > 0 and res["bitwise_compared"] > 0
+                  and res["crash_replay"]["skipped_corrupt"] == 0)
+    return records_identical, images_identical, dp4_ok, handoffs, resumed
 
 
 def _fault_drill():
@@ -378,6 +459,10 @@ def main(argv=None) -> int:
                          "numerics-neutral)")
     ap.add_argument("--skip-obs", action="store_true",
                     help="skip the telemetry-overhead check")
+    ap.add_argument("--skip-mesh", action="store_true",
+                    help="skip the mesh-parallel serving parity check "
+                         "(ISSUE 10; ~45s: dp=1 bitwise leg + the gated "
+                         "dp=4 chaos drill on the virtual 8-device mesh)")
     ap.add_argument("--skip-flight", action="store_true",
                     help="skip the flight-tracing parity check (ISSUE 7)")
     ap.add_argument("--bench-trend", action="store_true",
@@ -424,12 +509,14 @@ def main(argv=None) -> int:
         unknown = only - set(cases) - {"phase_gate", "serve_parity",
                                        "obs_overhead", "fault_drill",
                                        "static_analysis", "flight_parity",
-                                       "bench_trend", "lifecycle", "soak"}
+                                       "bench_trend", "lifecycle", "soak",
+                                       "mesh_parity"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
                      f"valid: {', '.join(cases)}, phase_gate, serve_parity, "
                      f"obs_overhead, fault_drill, static_analysis, "
-                     f"flight_parity, bench_trend, lifecycle, soak")
+                     f"flight_parity, bench_trend, lifecycle, soak, "
+                     f"mesh_parity")
 
     drifted = []
     for name, fn in cases.items():
@@ -497,6 +584,24 @@ def main(argv=None) -> int:
         print(benchwatch.render(report))
         if report["regressions"]:
             drifted.append("bench_trend")
+
+    if not args.skip_mesh and (only is None or "mesh_parity" in only):
+        try:
+            rec_id, img_id, dp4_ok, handoffs, resumed = _mesh_parity()
+        except AssertionError as e:  # DrillFailure in the dp=4 leg
+            print(f"{'mesh_parity':16s} INVARIANT VIOLATED: {e}")
+            drifted.append("mesh_parity")
+        else:
+            ok = rec_id and img_id and dp4_ok is not False
+            dp4_txt = ("skipped (<4 devices on this platform)"
+                       if dp4_ok is None else
+                       f"{handoffs} hand-offs, {resumed} resumed")
+            print(f"{'mesh_parity':16s} dp=1 records "
+                  f"{'byte-identical' if rec_id else 'DIFF'}, images "
+                  f"{'bitwise' if img_id else 'DIFF'}; dp=4 chaos drill "
+                  f"{dp4_txt} {'ok' if ok else 'DRIFT'}")
+            if not ok:
+                drifted.append("mesh_parity")
 
     if not args.skip_obs and (only is None or "obs_overhead" in only):
         overhead, identical, steps = _obs_overhead()
