@@ -1,6 +1,6 @@
 """jaxcheck — static analysis for the whole stack (docs/STATIC_ANALYSIS.md).
 
-Two passes, one structured report:
+Three passes, one structured report:
 
 - **Pass 1 (AST lints)** — :mod:`.astlint`: repo-specific TPU/JAX rules
   over the package source, with inline ``# jaxcheck: disable=<rule>``
@@ -13,10 +13,17 @@ Two passes, one structured report:
   in hot scans beyond the registered obs sinks, no CFG-doubled tensors in
   phase 2, donation as declared, and ``compile_key`` completeness over the
   full ``Request`` schema.
+- **Pass 3 (shardcheck)** — :mod:`.collectives` + :mod:`.shlo_walk`:
+  lower AND compile the canonical mesh serve programs
+  (``serve/{mesh,phase1-mesh,phase2-mesh}-dpN``, dp ∈ {1, 2, 4}) and
+  check the post-SPMD HLO against :data:`.collectives
+  .DECLARED_COLLECTIVES` in both directions (undeclared collective /
+  stale declaration), plus no-hidden-resharding and no-host-boundary —
+  emitting the per-program bytes-per-step comms table into the report.
 
-Drivers: ``tools/jaxcheck.py`` (CLI, ``--fix``, ``--update-baseline``),
-``p2p-tpu check --static``, and the ``static_analysis`` check in
-``tools/quality_gate.py``.
+Drivers: ``tools/jaxcheck.py`` (CLI, ``--fix``, ``--update-baseline``,
+``--only collectives``), ``p2p-tpu check --static``, and the
+``static_analysis`` check in ``tools/quality_gate.py``.
 """
 
 from .astlint import RULES, lint_file, lint_paths, lint_source  # noqa: F401
@@ -27,4 +34,9 @@ from .findings import (  # noqa: F401
     save_baseline,
     summarize,
 )
-from .report import run_all, run_ast_pass, run_contract_pass  # noqa: F401
+from .report import (  # noqa: F401
+    run_all,
+    run_ast_pass,
+    run_collectives_pass,
+    run_contract_pass,
+)

@@ -24,6 +24,14 @@ encode the TPU/JAX invariants this codebase keeps re-learning in review:
                     scope — forces backend init (and possibly device
                     memory) on *import*, before the CLI can pick a
                     platform.
+``unguarded-transfer`` implicit host↔device transfers in the serve
+                    dispatch-path modules: ``np.asarray``/``np.array`` on
+                    a value that didn't land via ``jax.device_get`` (a
+                    hidden d2h sync), or ``jnp.asarray``/``jnp.array``
+                    staging host data outside ``stage_host``/
+                    ``jax.device_put`` (a hidden h2d). The lint-time twin
+                    of the runtime ``jax.transfer_guard("disallow")``
+                    dispatch tests.
 ``unused-import``   dead imports (mechanical; ``--fix`` removes them).
 ``shadowed-name``   a binding that silently rebinds an imported name (or a
                     parameter that shadows a module-level import).
@@ -525,6 +533,72 @@ def _check_import_time(ctx: ModuleContext) -> Iterator[Finding]:
                             "lazily inside a function")
 
     yield from scan(ctx.tree.body)
+
+
+# ---------------------------------------------------------------------------
+# Rules — serve dispatch-path transfer hygiene
+# ---------------------------------------------------------------------------
+
+#: Repo-relative modules on the serve dispatch path: code that runs inside
+#: (or feeds) the engine's per-batch dispatch, which executes under
+#: ``jax.transfer_guard("disallow")``. Every host↔device crossing here must
+#: be explicit — ``stage_host``/``jax.device_put`` in, ``jax.device_get``
+#: out — so the rule below fires on the implicit spellings. Input-prep
+#: modules (``parallel/sweep.py`` stages via its own ``_stage_sharded``)
+#: keep the runtime guard only: the lint covers the modules whose implicit
+#: transfers the PR 9 guard test actually caught.
+DISPATCH_PATH_MODULES = (
+    "p2p_tpu/serve/programs.py",
+    "p2p_tpu/serve/handoff.py",
+    "p2p_tpu/serve/engine_loop.py",
+)
+
+_D2H_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_H2D_CALLS = {"jnp.asarray", "jnp.array", "jax.numpy.asarray",
+              "jax.numpy.array"}
+_STAGING_CALLS = {"stage_host", "device_put", "device_get"}
+
+
+def _is_dispatch_module(path: str) -> bool:
+    return path.replace(os.sep, "/").endswith(DISPATCH_PATH_MODULES)
+
+
+@rule("unguarded-transfer", "error",
+      "implicit host<->device transfer in a serve dispatch-path module "
+      "(bypasses stage_host / jax.device_get)")
+def _check_unguarded_transfer(ctx: ModuleContext) -> Iterator[Finding]:
+    if not _is_dispatch_module(ctx.path):
+        return
+    # Calls appearing as a *direct argument* of an explicit staging call
+    # are the sanctioned idiom (`stage_host(np.asarray(ids))`) — collect
+    # them first so they don't fire below.
+    staged: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _dotted(node.func).rsplit(
+                ".", 1)[-1] in _STAGING_CALLS:
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Call):
+                    staged.add(id(arg))
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or id(node) in staged:
+            continue
+        d = _dotted(node.func)
+        if d in _D2H_CALLS:
+            arg = node.args[0] if node.args else None
+            if isinstance(arg, ast.Call) and _dotted(arg.func) in (
+                    "jax.device_get", "device_get"):
+                continue   # the explicit d2h landing, host-copied: fine
+            yield ctx.finding(
+                "unguarded-transfer", node,
+                f"{d}() in a dispatch-path module: an implicit d2h sync "
+                "on a device value (land results via jax.device_get; "
+                "wrap host staging in stage_host)")
+        elif d in _H2D_CALLS:
+            yield ctx.finding(
+                "unguarded-transfer", node,
+                f"{d}() in a dispatch-path module: an implicit h2d "
+                "transfer the dispatch transfer guard would reject "
+                "(stage host values via stage_host / jax.device_put)")
 
 
 # ---------------------------------------------------------------------------

@@ -61,10 +61,22 @@ GATE = 2
 PROMPTS = ("a squirrel eating a burger", "a squirrel eating a lasagna")
 
 #: program name -> donated argument indices the code *declares*. The
-#: contract checks the lowering agrees in both directions.
+#: contract checks the lowering agrees in both directions, over every
+#: jitted entry point the serve stack dispatches: the monolithic sweep,
+#: the disaggregated phase-1/phase-2 pool programs, and all three again
+#: as MESH programs (dp-sharded group inputs — donation lowers through
+#: the partitioner, so the mesh twins are checked in their own right).
+#: Today every program declares *no* donation (sweep inputs are
+#: caller-reused; a hand-off carry outlives its phase-2 dispatch via the
+#: journal spill path).
 DECLARED_DONATION: Dict[str, Tuple[int, ...]] = {
     "text2image": (),
     "sweep": (),
+    "sweep/phase1": (),
+    "sweep/phase2": (),
+    "sweep/mesh": (),
+    "sweep/phase1-mesh": (),
+    "sweep/phase2-mesh": (),
 }
 
 
@@ -620,17 +632,22 @@ def _donated_params(lowered_text: str) -> int:
             + lowered_text.count("tf.aliasing_output"))
 
 
-def check_donation(pipe=None) -> List[ContractResult]:
-    """Lower the two jitted entry points and check buffer donation against
-    :data:`DECLARED_DONATION` — both directions (declared-but-absent and
-    applied-but-undeclared fail)."""
-    from ..engine.sampler import _text2image_jit
+def _donation_lowerings(pipe) -> Dict[str, str]:
+    """StableHLO text of every entry point :data:`DECLARED_DONATION`
+    names: the two historical programs plus the pool programs and their
+    mesh twins (group inputs staged under ``NamedSharding(P("dp"))`` on a
+    :func:`_mesh_dp`-wide mesh, the engine's ``--mesh`` dispatch shape)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine.sampler import (_text2image_jit, encode_prompts,
+                                  phase2_controller)
     from ..models.config import unet_layout
     from ..ops import schedulers as sched_mod
-    from ..parallel.sweep import _sweep_jit
+    from ..parallel.mesh import make_mesh
+    from ..parallel.sweep import (_sweep_jit, _sweep_phase1_jit,
+                                  _sweep_phase2_jit)
 
-    if pipe is None:
-        pipe = tiny_pipeline()
     cfg = pipe.config
     layout = unet_layout(cfg.unet)
     schedule = sched_mod.schedule_from_config(STEPS, cfg.scheduler,
@@ -638,6 +655,21 @@ def check_donation(pipe=None) -> List[ContractResult]:
     ctx, lats, gs = _scan_inputs(pipe)
     b = len(PROMPTS)
     cond, uncond = ctx[b:], ctx[:b]
+    ctrl = _edit_controller(pipe)
+    carry = _zero_carry(pipe, ctrl)
+    p2 = phase2_controller(ctrl)
+    cond_b = encode_prompts(pipe, list(PROMPTS))
+    lead1 = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda x: x[None], t)                  # one-lane group axis
+    dp = _mesh_dp()
+    mesh = make_mesh(dp, tp=1)
+
+    def lead_dp(t):
+        # dp whole lanes, staged under the engine's group-axis sharding
+        # (a 1-lane group can't split over a dp>1 mesh).
+        return jax.tree_util.tree_map(
+            lambda x: _stage_dp(jnp.broadcast_to(x[None], (dp,) + x.shape),
+                                mesh), t)
 
     lowerings = {
         "text2image": _text2image_jit.lower(
@@ -648,13 +680,58 @@ def check_donation(pipe=None) -> List[ContractResult]:
             pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
             "ddim", ctx[None], lats[None], None, gs, None, progress=False,
             gate=None, metrics=False),
+        "sweep/phase1": _sweep_phase1_jit.lower(
+            pipe.unet_params, cfg, layout, schedule, "ddim", ctx[None],
+            lats[None], lead1(ctrl), gs, progress=False, gate=GATE,
+            metrics=False),
+        "sweep/phase2": _sweep_phase2_jit.lower(
+            pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
+            "ddim", cond_b[None], lead1(carry), lead1(p2), gs,
+            progress=False, gate=GATE, metrics=False),
+        "sweep/mesh": _sweep_jit.lower(
+            pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
+            "ddim", lead_dp(ctx), lead_dp(lats), None, gs, None,
+            progress=False, gate=None, metrics=False),
+        "sweep/phase1-mesh": _sweep_phase1_jit.lower(
+            pipe.unet_params, cfg, layout, schedule, "ddim",
+            lead_dp(ctx), lead_dp(lats), lead_dp(ctrl), gs,
+            progress=False, gate=GATE, metrics=False),
+        "sweep/phase2-mesh": _sweep_phase2_jit.lower(
+            pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
+            "ddim", lead_dp(cond_b), lead_dp(carry), lead_dp(p2), gs,
+            progress=False, gate=GATE, metrics=False),
     }
+    return {name: low.as_text() for name, low in lowerings.items()}
+
+
+def check_donation(pipe=None,
+                   declared: Optional[Dict[str, Tuple[int, ...]]] = None,
+                   lowerings: Optional[Dict[str, str]] = None,
+                   ) -> List[ContractResult]:
+    """Lower every declared jitted entry point (monolithic, pool, and mesh
+    programs) and check buffer donation against :data:`DECLARED_DONATION`
+    — both directions (declared-but-absent and applied-but-undeclared
+    fail). ``declared``/``lowerings`` are injection points for the seeded
+    verdict-flip proofs in tests/test_jaxcheck.py."""
+    if declared is None:
+        declared = DECLARED_DONATION
+    if lowerings is None:
+        if pipe is None:
+            pipe = tiny_pipeline()
+        lowerings = _donation_lowerings(pipe)
     out = []
-    for name, declared in DECLARED_DONATION.items():
-        n = _donated_params(lowerings[name].as_text())
-        ok = (n > 0) == (len(declared) > 0)
+    for name, wants in declared.items():
+        text = lowerings.get(name)
+        if text is None:
+            out.append(ContractResult(
+                "donation-as-declared", name, False,
+                "declared program has no lowering in the sweep (stale "
+                "DECLARED_DONATION entry?)"))
+            continue
+        n = _donated_params(text)
+        ok = (n > 0) == (len(wants) > 0)
         detail = (f"{n} donated param(s) in lowering, "
-                  f"{len(declared)} declared")
+                  f"{len(wants)} declared")
         out.append(ContractResult("donation-as-declared", name, ok, detail))
     return out
 

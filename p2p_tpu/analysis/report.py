@@ -1,4 +1,4 @@
-"""Assemble both passes into one structured report.
+"""Assemble the analyzer passes into one structured report.
 
 The report is the analyzer's single output contract — ``tools/jaxcheck.py``
 prints/serializes it, ``tools/quality_gate.py``'s ``static_analysis`` check
@@ -6,14 +6,22 @@ consumes it, and ``p2p-tpu check --static`` wraps it. Shape:
 
 .. code-block:: json
 
-    {"version": 1,
+    {"version": 2,
      "ok": true,
      "ast": {"findings": [...], "summary": {"new": 0, ...}},
      "contracts": {"results": [...], "ok": true},
-     "compile_key": {"fields": [...], "ok": true}}
+     "compile_key": {"fields": [...], "ok": true},
+     "collectives": {"results": [...], "ok": true,
+                     "table": {"serve/mesh-dp2": {"ops": {},
+                               "bytes_per_step": 0, ...}}}}
 
-``ok`` is the gate verdict: no *new* AST findings (suppressed/baselined
-don't count) and every contract + compile-key field verdict holding.
+``ok`` is the gate verdict over the sections that ran: no *new* AST
+findings (suppressed/baselined don't count) and every contract,
+compile-key and shardcheck verdict holding. ``collectives.table`` is the
+per-program bytes-per-step comms budget (:mod:`.collectives`) downstream
+mesh work designs against. Sections are selectable (``only=`` /
+``tools/jaxcheck.py --only collectives``) for fast local iteration; the
+default runs everything.
 """
 
 from __future__ import annotations
@@ -24,7 +32,12 @@ from typing import Iterable, List, Optional
 from . import astlint
 from .findings import apply_baseline, load_baseline, summarize
 
-REPORT_VERSION = 1
+REPORT_VERSION = 2
+
+#: Selectable report sections (the ``only=`` vocabulary). ``ast`` is pass
+#: 1; ``contracts`` bundles the jaxpr contracts with the compile-key sweep
+#: (they share the traced canonical set); ``collectives`` is shardcheck.
+SECTIONS = ("ast", "contracts", "collectives")
 
 #: Default lint targets, relative to the repo root: the package plus the
 #: drivers that embed repo invariants. tests/ is deliberately out — tests
@@ -93,31 +106,72 @@ def run_contract_pass(pipe=None, buckets=(1, 2, 4, 8),
     }
 
 
+def run_collectives_pass(pipe=None, collective_dps=None) -> dict:
+    """Pass 3: shardcheck — the declared-collective / no-hidden-resharding
+    / no-host-boundary contracts over the compiled mesh serve programs,
+    plus the per-program bytes-per-step comms table (:mod:`.collectives`).
+    Lazy-imported for the same reason as pass 2 (and because this pass
+    additionally pays an XLA compile per program)."""
+    from . import collectives as coll_mod
+
+    dps = (coll_mod.SHARDCHECK_DPS if collective_dps is None
+           else tuple(collective_dps))
+    results, table = coll_mod.check_collectives(pipe, dps=dps)
+    return {"collectives": {"results": results,
+                            "ok": all(r.ok for r in results),
+                            "table": table}}
+
+
 def run_all(paths: Optional[Iterable[str]] = None,
             baseline_path: Optional[str] = None,
             root: Optional[str] = None,
             ast_only: bool = False,
-            buckets=(1, 2, 4, 8)) -> dict:
-    ast = run_ast_pass(paths, baseline_path=baseline_path, root=root)
-    report = {"version": REPORT_VERSION, "ast": ast}
+            buckets=(1, 2, 4, 8),
+            only: Optional[str] = None,
+            collective_dps=None) -> dict:
+    """Run the selected sections (default: all). ``ast_only`` is the
+    historical spelling of ``only="ast"``; ``only`` narrows to one section
+    (``tools/jaxcheck.py --only``); ``collective_dps`` narrows the
+    shardcheck dp sweep (the quality gate runs one dp for speed, the
+    analyzer's own tests sweep the axis)."""
+    if only is not None and only not in SECTIONS:
+        raise ValueError(f"only must be one of {SECTIONS}, got {only!r}")
     if ast_only:
-        report["ok"] = ast["summary"]["new"] == 0
-        return report
-    passes = run_contract_pass(buckets=buckets)
-    report.update(passes)
-    report["ok"] = (ast["summary"]["new"] == 0
-                    and passes["contracts"]["ok"]
-                    and passes["compile_key"]["ok"])
+        only = "ast"
+    sections = SECTIONS if only is None else (only,)
+    report: dict = {"version": REPORT_VERSION}
+    oks = []
+    if "ast" in sections:
+        ast = run_ast_pass(paths, baseline_path=baseline_path, root=root)
+        report["ast"] = ast
+        oks.append(ast["summary"]["new"] == 0)
+    pipe = None
+    if "contracts" in sections or "collectives" in sections:
+        # Both traced passes share one tiny pipeline (same construction,
+        # no reason to re-init weights per pass).
+        from . import contracts as contracts_mod
+
+        pipe = contracts_mod.tiny_pipeline()
+    if "contracts" in sections:
+        passes = run_contract_pass(pipe, buckets=buckets)
+        report.update(passes)
+        oks += [passes["contracts"]["ok"], passes["compile_key"]["ok"]]
+    if "collectives" in sections:
+        coll = run_collectives_pass(pipe, collective_dps=collective_dps)
+        report.update(coll)
+        oks.append(coll["collectives"]["ok"])
+    report["ok"] = all(oks)
     return report
 
 
 def to_json_dict(report: dict) -> dict:
     """The report with dataclasses rendered to plain dicts (the JSON file
     quality_gate and CI artifacts consume)."""
-    out = {"version": report["version"], "ok": report["ok"],
-           "ast": {"findings": [f.to_dict()
-                                for f in report["ast"]["findings"]],
-                   "summary": report["ast"]["summary"]}}
+    out = {"version": report["version"], "ok": report["ok"]}
+    if "ast" in report:
+        out["ast"] = {"findings": [f.to_dict()
+                                   for f in report["ast"]["findings"]],
+                      "summary": report["ast"]["summary"]}
     if "contracts" in report:
         out["contracts"] = {
             "ok": report["contracts"]["ok"],
@@ -131,19 +185,26 @@ def to_json_dict(report: dict) -> dict:
                         "key_changed": v.key_changed,
                         "ok": v.ok, "problem": v.problem}
                        for v in report["compile_key"]["fields"]]}
+    if "collectives" in report:
+        out["collectives"] = {
+            "ok": report["collectives"]["ok"],
+            "results": [r.to_dict()
+                        for r in report["collectives"]["results"]],
+            "table": report["collectives"]["table"]}
     return out
 
 
 def render_text(report: dict, verbose: bool = False) -> str:
     """Human-readable rendering (the CLI's default output)."""
     lines: List[str] = []
-    s = report["ast"]["summary"]
-    lines.append(f"AST pass: {s['new']} new finding(s) "
-                 f"({s['suppressed']} suppressed, {s['baselined']} "
-                 f"baselined, {s['total']} total)")
-    for f in report["ast"]["findings"]:
-        if f.is_new or verbose:
-            lines.append("  " + f.format())
+    if "ast" in report:
+        s = report["ast"]["summary"]
+        lines.append(f"AST pass: {s['new']} new finding(s) "
+                     f"({s['suppressed']} suppressed, {s['baselined']} "
+                     f"baselined, {s['total']} total)")
+        for f in report["ast"]["findings"]:
+            if f.is_new or verbose:
+                lines.append("  " + f.format())
     if "contracts" in report:
         c = report["contracts"]
         lines.append(f"Contract pass: "
@@ -160,6 +221,19 @@ def render_text(report: dict, verbose: bool = False) -> str:
         for v in k["fields"]:
             if not v.ok or verbose:
                 lines.append("  " + v.format())
+    if "collectives" in report:
+        c = report["collectives"]
+        lines.append(f"Shardcheck pass: "
+                     f"{sum(1 for r in c['results'] if not r.ok)} "
+                     f"failure(s) across {len(c['results'])} check(s)")
+        for r in c["results"]:
+            if not r.ok or verbose:
+                lines.append("  " + r.format())
+        lines.append("  collective budget (bytes/step | bytes once | ops):")
+        for name in sorted(c["table"]):
+            row = c["table"][name]
+            lines.append(f"    {name:26s} {row['bytes_per_step']:>10d} | "
+                         f"{row['bytes_once']:>10d} | {row['ops'] or '{}'}")
     lines.append("static analysis " + ("PASSED" if report["ok"]
                                        else "FAILED"))
     return "\n".join(lines)

@@ -649,12 +649,15 @@ def cmd_check(args) -> int:
             raise SystemExit("--static is the whole-stack analyzer; it "
                              "takes no checkpoint_dir/--preset")
         if not args.ast_only:
-            # Same backend pinning as tools/jaxcheck.py: the contract pass
-            # is a structure check, never device work — tracing on an
-            # accelerator would initialize it (and could lower donation
-            # differently), diverging from the CI driver's CPU verdict.
-            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-            os.environ["JAX_PLATFORMS"] = "cpu"
+            # Same backend pinning as tools/jaxcheck.py (one shared
+            # helper): the traced passes are structure checks, never
+            # device work — tracing on an accelerator would initialize it
+            # (and could lower donation differently), and a one-device
+            # run would degrade the shardcheck sweep to dp=1, where a
+            # real hidden all-gather at dp>=2 passes unseen.
+            from .utils.platform import force_cpu_platform
+
+            force_cpu_platform()
             import jax
 
             jax.config.update("jax_platforms", "cpu")
@@ -969,14 +972,18 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--preset", default=None,
                    choices=("sd14", "sd21", "sd21base", "ldm256"))
     c.add_argument("--static", action="store_true",
-                   help="run the two-pass static analyzer instead (AST "
-                        "lints + traced-program contracts — "
+                   help="run the three-pass static analyzer instead (AST "
+                        "lints + traced-program contracts + the "
+                        "shardcheck collective-budget pass — "
                         "docs/STATIC_ANALYSIS.md); exits nonzero on new "
-                        "findings or contract violations. Full flag "
-                        "surface: tools/jaxcheck.py")
+                        "findings or contract violations. Forces the "
+                        "virtual 8-device CPU platform so the shardcheck "
+                        "dp sweep matches the CI driver's. Full flag "
+                        "surface (--only, --fix, --update-baseline): "
+                        "tools/jaxcheck.py")
     c.add_argument("--ast-only", action="store_true",
                    help="with --static: skip the (slower) traced-program "
-                        "contract pass")
+                        "and shardcheck passes")
     c.set_defaults(fn=cmd_check)
     return p
 

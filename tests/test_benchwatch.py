@@ -102,10 +102,31 @@ def test_unparsed_rounds_are_skipped(benchwatch, tmp_path):
     assert report["prev_round"] == 2
 
 
-def test_empty_archive_is_a_note_not_a_crash(benchwatch, tmp_path):
+def test_empty_archive_is_an_explicit_note_and_exit_0(benchwatch, tmp_path,
+                                                      capsys):
+    """An empty bench trajectory (no BENCH_r*.json at all — the empty
+    ``bench_runs`` shape) is an explicit "no comparable round" note and
+    exit 0, not a silently-green table of per-key n/a rows."""
     report = benchwatch.watch(str(tmp_path), 0.10)
     assert not report["comparable"] and not report["regressions"]
+    assert report["rows"] == []
+    assert "no comparable round" in report["note"]
     assert benchwatch.main(["--root", str(tmp_path)]) == 0
+    assert "no comparable round" in capsys.readouterr().out
+
+
+def test_single_round_archive_is_an_explicit_note_and_exit_0(benchwatch,
+                                                             tmp_path,
+                                                             capsys):
+    # One round = nothing like-for-like to diff: same explicit-note
+    # contract as the empty archive, naming the round that lacks a twin.
+    _round(tmp_path, 1, _parsed(1.0, serve={"p95_ms": 500.0}))
+    report = benchwatch.watch(str(tmp_path), 0.10)
+    assert not report["comparable"] and report["rows"] == []
+    assert "no comparable round" in report["note"]
+    assert report["latest_round"] == 1
+    assert benchwatch.main(["--root", str(tmp_path)]) == 0
+    assert "no comparable round" in capsys.readouterr().out
 
 
 def test_dotted_lookup(benchwatch):

@@ -258,6 +258,60 @@ def test_parse_error_is_a_finding_not_a_crash():
     assert [f.rule for f in hits] == ["parse-error"]
 
 
+_TRANSFER_SRC = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    def dispatch(x, seed):
+        a = np.asarray(x)            # implicit d2h sync
+        b = jnp.asarray(seed)        # implicit h2d transfer
+        return a, b
+    """
+
+
+def test_unguarded_transfer_fires_only_in_dispatch_modules():
+    hits = lint(_TRANSFER_SRC, rules=("unguarded-transfer",),
+                path="p2p_tpu/serve/programs.py")
+    assert len(hits) == 2
+    assert any("d2h" in f.message for f in hits)
+    assert any("h2d" in f.message for f in hits)
+    # The same code outside the dispatch path is host-side prep: no fire.
+    assert lint(_TRANSFER_SRC, rules=("unguarded-transfer",),
+                path="p2p_tpu/utils/images.py") == []
+
+
+def test_unguarded_transfer_sanctioned_idioms_dont_fire():
+    # The explicit spellings the dispatch path is BUILT on: d2h lands via
+    # jax.device_get (host-copying the result is fine), h2d stages through
+    # stage_host / jax.device_put (wrapping a host constructor directly).
+    assert lint("""
+        import numpy as np
+        import jax
+
+        from ..engine.sampler import stage_host
+
+        def dispatch(x, req):
+            host = np.asarray(jax.device_get(x))
+            seed = stage_host(np.int32(req.seed))
+            ids = stage_host(np.asarray(req.tokens))
+            dev = jax.device_put(np.asarray(req.scale))
+            return host, seed, ids, dev
+        """, rules=("unguarded-transfer",),
+        path="p2p_tpu/serve/handoff.py") == []
+
+
+def test_unguarded_transfer_dispatch_modules_are_lint_clean():
+    # The committed dispatch path itself must hold the contract the rule
+    # encodes (the lint-time twin of the mesh transfer-guard test).
+    from p2p_tpu.analysis.astlint import DISPATCH_PATH_MODULES
+
+    for rel in DISPATCH_PATH_MODULES:
+        hits = [f for f in astlint.lint_file(
+                    os.path.join(REPO, rel), repo_root=REPO,
+                    rules=("unguarded-transfer",)) if f.is_new]
+        assert hits == [], [f.format() for f in hits]
+
+
 # ---------------------------------------------------------------------------
 # Suppression + baseline semantics
 # ---------------------------------------------------------------------------
@@ -482,6 +536,29 @@ def test_cli_update_baseline_refuses_disabled_baseline(tmp_path):
     assert "conflicts" in proc.stderr
 
 
+def test_cli_only_selector_flag_validation(tmp_path):
+    # All usage errors, caught by argparse before any jax import: an
+    # unknown section, --ast-only fighting --only, lint targets passed to
+    # a pass that never lints, and --update-baseline without an AST pass.
+    proc = _run_jaxcheck(["--only", "bogus"])
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    proc = _run_jaxcheck(["--ast-only", "--only", "collectives"])
+    assert proc.returncode == 2 and "conflicts" in proc.stderr
+    proc = _run_jaxcheck(["--only", "collectives", str(tmp_path)])
+    assert proc.returncode == 2 and "lint targets" in proc.stderr
+    proc = _run_jaxcheck(["--only", "collectives", "--update-baseline",
+                          "--baseline", str(tmp_path / "b.json")])
+    assert proc.returncode == 2 and "AST pass" in proc.stderr
+    proc = _run_jaxcheck(["--fix", "--only", "collectives"])
+    assert proc.returncode == 2 and "--fix needs the AST pass" in proc.stderr
+    # --ast-only is still the working shorthand for --only ast.
+    good = tmp_path / "good.py"
+    good.write_text("def f(x):\n    return x\n")
+    proc = _run_jaxcheck(["--ast-only", "--only", "ast", "--baseline", "",
+                          str(good)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
 def test_cli_rejects_nonexistent_lint_target(tmp_path):
     # A typo'd path must be a usage error (exit 2), never a vacuous pass.
     proc = _run_jaxcheck(["--ast-only", "--baseline", "",
@@ -650,6 +727,43 @@ def test_trace_invisible_flags_a_tracer_dependent_program(tiny_pipe):
     assert "fingerprint changed" in results[0].detail
 
 
+def test_donation_sweep_covers_pool_and_mesh_programs(tiny_pipe):
+    """ISSUE 11 satellite: donation-as-declared extends past text2image/
+    sweep to the phase-1/phase-2 pool programs and all three mesh twins —
+    every declared name lowers and holds."""
+    from p2p_tpu.analysis.contracts import DECLARED_DONATION, check_donation
+
+    res = check_donation(tiny_pipe)
+    assert {r.program for r in res} == set(DECLARED_DONATION)
+    assert {"sweep/phase1", "sweep/phase2", "sweep/mesh",
+            "sweep/phase1-mesh", "sweep/phase2-mesh"} <= set(
+                DECLARED_DONATION)
+    assert all(r.ok for r in res), [r.format() for r in res]
+
+
+def test_donation_verdict_flips_both_directions():
+    """Seeded proof that the donation contract actually bites, in both
+    directions, plus the stale-name leg."""
+    from p2p_tpu.analysis.contracts import check_donation
+
+    # Declared-but-absent: the declaration says arg 0 donates, the
+    # lowering carries no donor annotations.
+    res = check_donation(declared={"sweep/phase1": (0,)},
+                         lowerings={"sweep/phase1": "module @jit_f {}"})
+    assert len(res) == 1 and not res[0].ok
+    assert "0 donated param(s) in lowering, 1 declared" in res[0].detail
+    # Applied-but-undeclared: the lowering donates, the declaration is ().
+    res = check_donation(
+        declared={"sweep/phase2": ()},
+        lowerings={"sweep/phase2":
+                   'tensor<4xf32> {jax.buffer_donor = true}'})
+    assert len(res) == 1 and not res[0].ok
+    # A declared name the sweep no longer lowers is an error, not a skip.
+    res = check_donation(declared={"ghost": ()}, lowerings={"sweep": ""})
+    assert len(res) == 1 and not res[0].ok
+    assert "no lowering" in res[0].detail
+
+
 # ---------------------------------------------------------------------------
 # Compile-key completeness (the acceptance regression)
 # ---------------------------------------------------------------------------
@@ -791,7 +905,7 @@ def test_report_verdict_flips_on_contract_class_violation(tmp_path,
     from p2p_tpu.analysis.compile_key import FieldVerdict
     from p2p_tpu.analysis.contracts import ContractResult
 
-    def seeded_failure(**kw):
+    def seeded_failure(*a, **kw):
         return {
             "contracts": {"results": [ContractResult(
                 "hot-scan-callbacks", "serve/bucket1", False,
@@ -801,7 +915,12 @@ def test_report_verdict_flips_on_contract_class_violation(tmp_path,
                 "ok": False},
         }
 
+    def clean_collectives(*a, **kw):
+        return {"collectives": {"results": [], "ok": True, "table": {}}}
+
     monkeypatch.setattr(report_mod, "run_contract_pass", seeded_failure)
+    monkeypatch.setattr(report_mod, "run_collectives_pass",
+                        clean_collectives)
     clean = tmp_path / "clean.py"
     clean.write_text("x = 1\n")
     rep = report_mod.run_all(paths=[str(clean)], baseline_path="")

@@ -18,7 +18,10 @@ with on-chip rounds):
 - The predecessor is the most recent earlier round whose headline
   ``metric`` matches the latest round's — an on-chip sd14 round is never
   diffed against a tiny-CPU fallback (a 94% "regression" that is really a
-  preset change). No comparable predecessor ⇒ a note and exit 0.
+  preset change). No comparable predecessor — an empty archive, a
+  single-round trajectory, or a metric with no earlier twin — is an
+  explicit "no comparable round" note and exit 0, never a silently-green
+  table of per-key ``n/a`` rows.
 - A key is compared only when both rounds carry it numerically; missing
   keys report ``n/a`` and never fail the watch (early rounds predate the
   serve/obs blocks).
@@ -133,11 +136,12 @@ def watch(root: str, threshold: float = 0.10) -> dict:
     latest, prev = pick_comparison(rounds)
     if latest is None:
         return {"comparable": False, "rows": [], "regressions": [],
-                "note": "no BENCH_r*.json rounds with a parsed headline"}
+                "note": ("no comparable round: no BENCH_r*.json rounds "
+                         "with a parsed headline in the archive")}
     if prev is None:
         return {"comparable": False, "rows": [], "regressions": [],
                 "latest_round": latest[0],
-                "note": (f"round r{latest[0]:02d} "
+                "note": (f"no comparable round: r{latest[0]:02d} "
                          f"({latest[1].get('metric')}) has no earlier "
                          f"round with the same headline metric — nothing "
                          f"like-for-like to diff")}

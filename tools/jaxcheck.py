@@ -1,9 +1,10 @@
 """jaxcheck — the repo's static analyzer (docs/STATIC_ANALYSIS.md).
 
-Two passes over the stack, one exit code:
+Three passes over the stack, one exit code:
 
-    python tools/jaxcheck.py                  # both passes, full report
+    python tools/jaxcheck.py                  # all passes, full report
     python tools/jaxcheck.py --ast-only       # milliseconds: lints only
+    python tools/jaxcheck.py --only collectives  # just the shardcheck pass
     python tools/jaxcheck.py --json out.json  # structured report for CI
     python tools/jaxcheck.py --fix            # mechanical fixes in place
     python tools/jaxcheck.py --update-baseline  # accept current findings
@@ -39,8 +40,14 @@ def main(argv=None) -> int:
                     help="lint targets (files/dirs, default: the package + "
                          "tool drivers)")
     ap.add_argument("--ast-only", action="store_true",
-                    help="skip the traced-program contract pass (no jax "
-                         "import; milliseconds)")
+                    help="skip the traced-program passes (no jax import; "
+                         "milliseconds) — shorthand for --only ast")
+    ap.add_argument("--only", default=None,
+                    choices=("ast", "contracts", "collectives"),
+                    help="run a single report section: 'ast' (pass 1), "
+                         "'contracts' (jaxpr contracts + compile-key "
+                         "sweep), or 'collectives' (the shardcheck pass "
+                         "alone — fast local iteration on mesh programs)")
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help="baseline file (default: tools/"
                          "jaxcheck_baseline.json; '' disables)")
@@ -65,12 +72,34 @@ def main(argv=None) -> int:
         # from a de-baselined run would be the opposite of what was asked.
         ap.error("--update-baseline conflicts with --baseline '' "
                  "(baselining disabled); name the file to write")
+    if args.ast_only and args.only not in (None, "ast"):
+        ap.error(f"--ast-only conflicts with --only {args.only}")
+    if args.ast_only:
+        args.only = "ast"
+    if args.update_baseline and args.only not in (None, "ast"):
+        # The baseline is AST-pass state; accepting it from a run that
+        # never lints would silently wipe the file.
+        ap.error("--update-baseline needs the AST pass (drop --only, or "
+                 "use --only ast)")
+    if args.paths and args.only in ("contracts", "collectives"):
+        # Honored-flags discipline: lint targets would be silently unread.
+        ap.error(f"lint targets only apply to the AST pass; "
+                 f"--only {args.only} takes none")
+    if args.fix and args.only in ("contracts", "collectives"):
+        # --fix rewrites lint targets and re-lints them; a run that never
+        # lints would rewrite files whose state the report never reflects.
+        ap.error(f"--fix needs the AST pass (drop --only {args.only})")
 
-    if not args.ast_only:
-        # The contract pass imports jax: pin the deterministic CPU backend
-        # first (the passes are structure checks, never device work).
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-        os.environ["JAX_PLATFORMS"] = "cpu"
+    if args.only != "ast":
+        # The traced passes import jax: pin the deterministic CPU backend
+        # first (the passes are structure checks, never device work), and
+        # force the virtual 8-device platform (same helper as the other
+        # drivers) so the sharded canonical programs and the shardcheck
+        # dp ∈ {1,2,4} sweep run everywhere this driver does, not only
+        # where an operator exported XLA_FLAGS.
+        from p2p_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform()
 
     from p2p_tpu.analysis import report as report_mod
 
@@ -107,7 +136,7 @@ def main(argv=None) -> int:
 
     try:
         report = report_mod.run_all(paths, baseline_path=args.baseline,
-                                    ast_only=args.ast_only, buckets=buckets)
+                                    only=args.only, buckets=buckets)
     except FileNotFoundError as e:
         ap.error(str(e))   # a typo'd target is a usage error (exit 2)
 
@@ -121,10 +150,18 @@ def main(argv=None) -> int:
         print(f"baseline updated: {baseline_path} "
               f"({report['ast']['summary']['new']} finding(s) accepted)")
         # Re-baseline the in-memory report so the exit code reflects the
-        # file just written.
-        report = report_mod.run_all(paths, baseline_path=baseline_path,
-                                    ast_only=args.ast_only,
-                                    buckets=buckets)
+        # file just written — AST section only: the traced/compiled
+        # sections are baseline-independent, and re-running them would
+        # re-trace (and re-compile) every canonical program for an
+        # identical result.
+        report["ast"] = report_mod.run_ast_pass(
+            paths, baseline_path=baseline_path)
+        oks = [report["ast"]["summary"]["new"] == 0]
+        if "contracts" in report:
+            oks += [report["contracts"]["ok"], report["compile_key"]["ok"]]
+        if "collectives" in report:
+            oks.append(report["collectives"]["ok"])
+        report["ok"] = all(oks)
 
     print(report_mod.render_text(report, verbose=args.verbose))
     if args.json:

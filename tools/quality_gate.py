@@ -25,19 +25,16 @@ import sys
 import time
 
 # Force the deterministic CPU backend before any jax import: quality is
-# platform-independent, and the goldens are pinned on CPU (same scrub the
-# test conftest applies). The virtual 8-device platform (same flag as the
-# conftest) gives the mesh_parity check a real mesh to span; it changes
+# platform-independent, and the goldens are pinned on CPU (same shared
+# helper as the analyzer drivers). The virtual 8-device platform gives
+# the mesh_parity and shardcheck checks a real mesh to span; it changes
 # nothing for the single-device checks (device 0 numerics are identical).
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-_xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        _xla_flags + " --xla_force_host_platform_device_count=8").strip()
-
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
+
+from p2p_tpu.utils.platform import force_cpu_platform  # noqa: E402
+
+force_cpu_platform()
 
 from p2p_tpu.utils.cache import default_cache_dir  # noqa: E402
 
@@ -413,29 +410,38 @@ def _timed(run, metrics):
 
 
 def _static_analysis():
-    """The jaxcheck report (ISSUE 5): both analyzer passes — AST lints
-    against the committed baseline, traced-program contracts (no f64, no
-    hot-scan callbacks, phase-2 footprint, donation-as-declared) and the
-    compile-key completeness sweep over the full Request schema. The gate
-    fails on any NEW lint finding (suppressed/baselined don't count) or
-    any contract/field violation — the same verdict ``python
-    tools/jaxcheck.py`` exits on. One bucket keeps the in-gate run fast;
-    the bucket axis is swept by the analyzer's own tests."""
+    """The jaxcheck report (ISSUE 5 + ISSUE 11): every analyzer pass —
+    AST lints against the committed baseline, traced-program contracts
+    (no f64, no hot-scan callbacks, phase-2 footprint,
+    donation-as-declared), the compile-key completeness sweep over the
+    full Request schema, and the shardcheck pass (declared collectives /
+    no hidden resharding / no host boundary over the compiled mesh serve
+    programs). The gate fails on any NEW lint finding
+    (suppressed/baselined don't count) or any contract/field/shardcheck
+    violation — the same verdict ``python tools/jaxcheck.py`` exits on.
+    One bucket and one mesh width (dp=2: the narrowest non-degenerate
+    mesh) keep the in-gate run fast; the bucket and dp axes are swept by
+    the analyzer CLI and its own tests."""
     from p2p_tpu.analysis import report as report_mod
 
-    report = report_mod.run_all(buckets=(1,))
+    report = report_mod.run_all(buckets=(1,), collective_dps=(2,))
     new = report["ast"]["summary"]["new"]
     contract_fails = [r for r in report["contracts"]["results"] if not r.ok]
     key_fails = [v for v in report["compile_key"]["fields"] if not v.ok]
+    shard_fails = [r for r in report["collectives"]["results"] if not r.ok]
+    shard_bytes = sum(row["bytes_per_step"]
+                      for row in report["collectives"]["table"].values())
     detail = []
     for f in report["ast"]["findings"]:
         if f.is_new:
             detail.append("  " + f.format())
     detail += ["  " + r.format() for r in contract_fails]
     detail += ["  " + v.format() for v in key_fails]
+    detail += ["  " + r.format() for r in shard_fails]
     return (report["ok"], new, len(report["contracts"]["results"]),
             len(contract_fails), len(report["compile_key"]["fields"]),
-            len(key_fails), detail)
+            len(key_fails), len(report["collectives"]["results"]),
+            len(shard_fails), shard_bytes, detail)
 
 
 def main(argv=None) -> int:
@@ -489,9 +495,10 @@ def main(argv=None) -> int:
                          "invariants (fake runners, ~1 min); also "
                          "reachable as --only soak")
     ap.add_argument("--skip-static", action="store_true",
-                    help="skip the static-analysis check (ISSUE 5; ~60s: "
-                         "AST lints + traced-program contracts + the "
-                         "compile-key completeness sweep)")
+                    help="skip the static-analysis check (ISSUE 5 + 11; "
+                         "~90s: AST lints + traced-program contracts + "
+                         "the compile-key completeness sweep + the "
+                         "shardcheck collective-budget pass at dp=2)")
     ap.add_argument("--obs-overhead", type=float, default=1.5,
                     help="max fractional wall-clock overhead of the "
                          "metrics-enabled sampler vs disabled (ISSUE 3 "
@@ -681,11 +688,13 @@ def main(argv=None) -> int:
                   f"snapshots ok")
 
     if not args.skip_static and (only is None or "static_analysis" in only):
-        ok, new, n_contracts, bad_contracts, n_fields, bad_fields, detail = \
-            _static_analysis()
+        (ok, new, n_contracts, bad_contracts, n_fields, bad_fields,
+         n_shard, bad_shard, shard_bytes, detail) = _static_analysis()
         print(f"{'static_analysis':16s} {new} new lint finding(s), "
               f"{bad_contracts}/{n_contracts} contract failure(s), "
-              f"{bad_fields}/{n_fields} compile-key violation(s) "
+              f"{bad_fields}/{n_fields} compile-key violation(s), "
+              f"{bad_shard}/{n_shard} shardcheck failure(s) "
+              f"({shard_bytes}B/step collective budget) "
               f"{'ok' if ok else 'DRIFT'}")
         for line in detail:
             print(line)
