@@ -179,6 +179,26 @@ def _secondaries_filter(preset, env_value):
         keys.add("dpm")  # dpm_batched reuses the controller dpm builds
     return frozenset(keys)
 
+_TOOL_MODULES = {}
+
+
+def _load_tool(name):
+    """Load a tools/*.py module by file path (they are scripts, not a
+    package) — one loader, one module object, for every bench block that
+    borrows a drill (the serve `slo` block and the resilience block both
+    use chaos_drill)."""
+    if name not in _TOOL_MODULES:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "tools", f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _TOOL_MODULES[name] = mod
+    return _TOOL_MODULES[name]
+
+
 _BENCH_RUNS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "bench_runs")
 
@@ -850,15 +870,9 @@ def _measure(preset):
         # trace is sized so the batcher runs at steady occupancy (arrivals
         # far denser than a batch's service time).
         def serve_rehearsal():
-            import importlib.util
-
             from p2p_tpu.serve import Request, serve_forever
 
-            spec = importlib.util.spec_from_file_location(
-                "loadgen", os.path.join(os.path.dirname(
-                    os.path.abspath(__file__)), "tools", "loadgen.py"))
-            loadgen = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(loadgen)
+            loadgen = _load_tool("loadgen")
 
             n = 16 if full else 24
             trace_dicts = loadgen.generate_trace(
@@ -1017,6 +1031,19 @@ def _measure(preset):
                 "handoffs": phm["handoffs"],
             }
 
+            # SLO-tiered overload protection (ISSUE 12): the seeded
+            # tenant/tier-mixed 2x-overload drill on the deterministic
+            # virtual clock (tools/chaos_drill.slo_overload_drill, the
+            # same scenario the quality gate's `slo` check enforces).
+            # The headline key is premium_p99_ratio — premium p99 under
+            # the overload over its uncontended p99 (bound 1.2x, watched
+            # by tools/benchwatch.py, direction: lower is better); the
+            # shed split records that best-effort absorbed the overload.
+            # All control-flow facts on an injected clock, so the
+            # sub-record is byte-stable across rounds and hosts.
+            extras["serve"]["slo"] = _load_tool(
+                "chaos_drill").slo_overload_drill(pipe)
+
         # Telemetry-overhead block (ISSUE 3): the same headline single-group
         # edit run with the obs instrumentation enabled (phase-tagged step
         # callbacks traced in, host collector installed) vs disabled, so
@@ -1070,13 +1097,7 @@ def _measure(preset):
         # identical to fault-free), so a resilience regression fails the
         # rehearsal rather than just skewing a number.
         def resilience_drill():
-            import importlib.util
-
-            spec = importlib.util.spec_from_file_location(
-                "chaos_drill", os.path.join(os.path.dirname(
-                    os.path.abspath(__file__)), "tools", "chaos_drill.py"))
-            drill = importlib.util.module_from_spec(spec)
-            spec.loader.exec_module(drill)
+            drill = _load_tool("chaos_drill")
 
             # Full scale serves the trace four times: keep it small there,
             # standard-drill-sized everywhere else (matching quality_gate's

@@ -81,6 +81,12 @@ VARIANTS: Dict[str, Tuple[object, dict]] = {
     "arrival_ms": (125.0, {}),
     "deadline_ms": (5000.0, {}),
     "priority": (3, {}),
+    # SLO scheduling metadata (ISSUE 12): pure scheduler inputs — they
+    # must change neither the program nor any compile key (tiers must
+    # not fragment programs; the tier joins the *batch* key only, and
+    # only under an active SloConfig).
+    "tenant": ("acme", {}),
+    "tier": ("premium", {}),
 }
 
 

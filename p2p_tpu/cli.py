@@ -546,6 +546,21 @@ def cmd_serve(args) -> int:
         degrade = DegradeConfig(depth_threshold=args.degrade_depth,
                                 window_ms=args.degrade_window_ms,
                                 min_bucket=args.degrade_min_bucket)
+    slo = None
+    if args.slo or args.tenant_quota is not None \
+            or args.preempt_depth is not None:
+        from .serve import SloConfig
+
+        try:
+            slo = SloConfig(tenant_quota=args.tenant_quota,
+                            preempt_depth=args.preempt_depth)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        if args.preempt_depth is not None and not args.journal:
+            print("warning: --preempt-depth without --journal parks "
+                  "preempted carries in memory only — a crash mid-park "
+                  "re-runs phase 1 instead of resuming off a spill",
+                  file=sys.stderr)
 
     out = open(args.results, "w") if args.results else sys.stdout
 
@@ -589,6 +604,7 @@ def cmd_serve(args) -> int:
                     phase_pools=not args.single_pool,
                     phase2_max_batch=args.phase2_max_batch,
                     mesh=mesh_spec,
+                    slo=slo,
                     flight=flight_tracer,
                     lifecycle=drain_ctl,
                     snapshot_every_ms=args.snapshot_every_ms,
@@ -963,6 +979,25 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--degrade-min-bucket", type=int, default=2,
                    choices=(1, 2, 4),
                    help="floor for the level-2 max-lane-bucket shrink")
+    s.add_argument("--slo", action="store_true",
+                   help="enable SLO-tiered multi-tenant scheduling: "
+                        "requests carrying tenant/tier fields get "
+                        "weighted-fair admission ordering, tier-pure "
+                        "batches, tier-ordered dispatch, and per-tier "
+                        "degradation (best-effort sheds first; premium "
+                        "is exempt from the level-1 force-gate) — "
+                        "docs/SERVING.md#slo-tiers-and-preemption")
+    s.add_argument("--tenant-quota", type=int, default=None, metavar="N",
+                   help="max outstanding requests per named tenant "
+                        "(implies --slo); excess submissions reject with "
+                        "the 'quota' kind")
+    s.add_argument("--preempt-depth", type=int, default=None, metavar="N",
+                   help="phase-boundary preemption (implies --slo): when "
+                        "outstanding work exceeds N while higher-tier "
+                        "work waits, lower-tier requests parked between "
+                        "their phases spill their carry (journaled "
+                        "'preempted' record) and resume when pressure "
+                        "clears")
     s.set_defaults(fn=cmd_serve)
 
     c = sub.add_parser(

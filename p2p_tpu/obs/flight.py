@@ -65,9 +65,12 @@ from . import spans as spans_mod
 
 #: Stages whose durations are the latency *attribution* of a request: they
 #: tile [arrival, terminal] in virtual time, so their sum must equal the
-#: recorded total (the flight record's self-check).
-ATTRIBUTION_STAGES = ("queue_wait", "handoff_wait", "requeue_wait",
-                      "fault", "backoff", "compile", "run")
+#: recorded total (the flight record's self-check). ``preempt_wait`` is
+#: the span a request spent *parked* by the SLO scheduler's phase-boundary
+#: preemption (serve.scheduling) — split out of the hand-off wait so the
+#: scheduler owns its own milliseconds.
+ATTRIBUTION_STAGES = ("queue_wait", "handoff_wait", "preempt_wait",
+                      "requeue_wait", "fault", "backoff", "compile", "run")
 
 
 def trace_id(request_id: str, epoch: int) -> str:

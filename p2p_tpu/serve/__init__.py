@@ -27,6 +27,7 @@ from .meshing import MeshSpec, parse_mesh
 from .programs import ProgramCache
 from .queue import AdmissionQueue, Rejected
 from .request import Cancel, Request, parse_jsonl_line, prepare
+from .scheduling import TIERS, FairClock, SloConfig
 
 __all__ = [
     "AdmissionQueue",
@@ -35,6 +36,7 @@ __all__ = [
     "DegradeConfig",
     "DrainController",
     "DynamicBatcher",
+    "FairClock",
     "FaultPlan",
     "HandoffEntry",
     "InjectedFault",
@@ -46,6 +48,8 @@ __all__ = [
     "Request",
     "RetryPolicy",
     "SimulatedKill",
+    "SloConfig",
+    "TIERS",
     "WatchdogTimeout",
     "bucket_for",
     "classify",

@@ -56,6 +56,7 @@ class Batch:
     batch_key: Tuple
     entries: List[Entry]
     created_ms: float
+    urgent: bool = False        # flushed by the deadline jump (flush_key)
 
     @property
     def compile_key(self) -> Tuple:
@@ -118,6 +119,63 @@ class DynamicBatcher:
         if not self._oldest_ms:
             return None
         return min(self._oldest_ms.values()) + self.max_wait_ms
+
+    # -- SLO-scheduler accessors (serve.scheduling) ------------------------
+    # The engine's preemption and deadline-jump passes need to look inside
+    # (and surgically edit) the waiting buckets; these keep the dict
+    # private while exposing exactly what the scheduler reads.
+
+    def entries(self):
+        """Iterate every waiting entry (bucket order, arrival order)."""
+        for group in self._waiting.values():
+            yield from group
+
+    def waiting_keys(self) -> List[Tuple]:
+        return list(self._waiting)
+
+    def group(self, key: Tuple) -> List[Entry]:
+        return list(self._waiting.get(key, ()))
+
+    def group_flush_at(self, key: Tuple) -> Optional[float]:
+        """When this bucket would age out naturally (None if absent)."""
+        if key not in self._oldest_ms:
+            return None
+        return self._oldest_ms[key] + self.max_wait_ms
+
+    def remove_if(self, pred: Callable[[Entry], bool]) -> List[Entry]:
+        """Remove (and return) every waiting entry matching ``pred`` —
+        the phase-boundary preemption hook: parked entries leave the
+        bucket; the survivors keep their bucket's age (a preemption must
+        never *delay* the work it was meant to favor)."""
+        removed: List[Entry] = []
+        for key in list(self._waiting):
+            keep: List[Entry] = []
+            took: List[Entry] = []
+            for e in self._waiting[key]:
+                (took if pred(e) else keep).append(e)
+            if not took:
+                continue
+            removed.extend(took)
+            if keep:
+                self._waiting[key] = keep
+            else:
+                del self._waiting[key]
+                del self._oldest_ms[key]
+        if removed:
+            self._m_waiting.labels(pool=self.pool).set(len(self))
+        return removed
+
+    def flush_key(self, key: Tuple, now_ms: float) -> List[Batch]:
+        """Flush one bucket immediately (the deadline-jump path): the
+        engine decided its entries cannot afford to age out. Counted as
+        its own flush cause (``urgent``)."""
+        out: List[Batch] = []
+        while key in self._waiting:
+            b = self._pop(key, self.max_batch, now_ms)
+            b.urgent = True
+            out.append(b)
+            self._m_flush.labels(cause="urgent", pool=self.pool).inc()
+        return out
 
     def _pop(self, key: Tuple, n: int, now_ms: float) -> Batch:
         group = self._waiting[key]

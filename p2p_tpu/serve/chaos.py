@@ -63,7 +63,15 @@ from typing import Dict, Optional, Sequence, Tuple
 SIGTERM = "sigterm"
 KILL_DURING_DRAIN = "kill_during_drain"
 KILL_DURING_SNAPSHOT = "kill_during_snapshot"
-LIFECYCLE_KINDS = (SIGTERM, KILL_DURING_DRAIN, KILL_DURING_SNAPSHOT)
+#: ISSUE 12: force a phase-boundary *preemption* of the keyed dispatch's
+#: victims (their carry parks via the spill path with a journaled
+#: ``preempted`` record), then die before the parked work resumes — the
+#: kill fires at the first batch-boundary sync after the park. The
+#: restart must resume the victim in phase 2 off the spill exactly like a
+#: crashed hand-off: exactly-once, bitwise-identical outputs.
+PREEMPT_THEN_KILL = "preempt_then_kill"
+LIFECYCLE_KINDS = (SIGTERM, KILL_DURING_DRAIN, KILL_DURING_SNAPSHOT,
+                   PREEMPT_THEN_KILL)
 
 KINDS = ("transient", "poison", "fatal", "hang", "nan") + LIFECYCLE_KINDS
 
@@ -123,8 +131,10 @@ class FaultPlan:
     def arm_kill(self, kind: str) -> None:
         """A ``kill_during_*`` fault was taken at its keyed dispatch: the
         kill itself fires later, at the matching lifecycle point (the next
-        drain-mode dispatch / the next snapshot's durable moment)."""
-        if kind not in (KILL_DURING_DRAIN, KILL_DURING_SNAPSHOT):
+        drain-mode dispatch / the next snapshot's durable moment / the
+        batch-boundary sync after a forced preemption)."""
+        if kind not in (KILL_DURING_DRAIN, KILL_DURING_SNAPSHOT,
+                        PREEMPT_THEN_KILL):
             raise ValueError(f"not a kill kind: {kind!r}")
         self._armed_kills.add(kind)
 

@@ -161,6 +161,7 @@ from . import handoff as handoff_mod
 from . import lifecycle as lifecycle_mod
 from . import meshing as meshing_mod
 from . import queue as queue_mod
+from . import scheduling as scheduling_mod
 from .batcher import BUCKET_SIZES, Batch, DynamicBatcher, bucket_for
 from .faults import RetryPolicy
 from .handoff import HandoffEntry
@@ -257,17 +258,28 @@ class _Trace:
         return getattr(self._next, "arrival_ms", self._last_arrival)
 
 
+def _warm_bucket(n: int, compile_key, max_batch: int, cache: ProgramCache,
+                 sizes=BUCKET_SIZES) -> Optional[int]:
+    """Smallest already-compiled bucket that holds ``n`` lanes for this
+    compile key (≤ ``max_batch``), or None if no warm program fits — the
+    single definition of "warm" shared by dispatch padding
+    (:func:`_pick_bucket`) and the deadline jump (``jump_urgent``), so
+    the two sites can never drift apart on warm-preference rules."""
+    smallest = bucket_for(n, max_batch, sizes)
+    for b in sizes:
+        if b >= smallest and b <= max_batch and (compile_key, b) in cache:
+            return b
+    return None
+
+
 def _pick_bucket(n: int, compile_key, max_batch: int, cache: ProgramCache,
                  sizes=BUCKET_SIZES) -> int:
     """Smallest bucket that fits — unless a larger bucket for the same
     compile key is already warm, in which case pad up to it: a few wasted
     lanes beat compiling (and caching) one more program. ``sizes`` is the
     engine's active bucket set (the dp-scaled one under a mesh)."""
-    smallest = bucket_for(n, max_batch, sizes)
-    for b in sizes:
-        if b >= smallest and b <= max_batch and (compile_key, b) in cache:
-            return b
-    return smallest
+    warm = _warm_bucket(n, compile_key, max_batch, cache, sizes)
+    return warm if warm is not None else bucket_for(n, max_batch, sizes)
 
 
 def _shrunken_bucket(max_batch: int, floor: int) -> int:
@@ -315,6 +327,7 @@ def serve_forever(
     snapshot_every_ms: Optional[float] = None,
     drain_timeout_ms: Optional[float] = None,
     mesh=None,
+    slo=None,
 ) -> Iterator[dict]:
     """Drain ``requests`` (Request/Cancel objects or JSONL-shaped dicts,
     sorted by ``arrival_ms``) through the queue → batcher → program-cache →
@@ -381,6 +394,25 @@ def serve_forever(
     ``dp=1`` is bitwise-identical to ``mesh=None``; ``dp>1`` matches at
     the repo's documented vmap tolerance (tests/test_serve_mesh.py,
     quality-gate ``mesh_parity``).
+
+    ``slo`` (None | ``serve.scheduling.SloConfig``) enables SLO-tiered
+    multi-tenant scheduling (docs/SERVING.md "SLO tiers and preemption"):
+    weighted-fair admission ordering and per-tenant outstanding quotas on
+    the queue (reject kind ``quota``); tier-pure batches (the tier joins
+    the *batch* key only — compiled programs are shared across tiers) and
+    tier-ordered dispatch; phase-boundary preemption (under pressure,
+    lower-tier work parked between its phases spills its carry via the
+    journal's hand-off path with a ``preempted`` WAL record and resumes
+    when pressure clears — a preempted-then-killed request resumes off
+    the spill exactly like a crashed hand-off); deadline-aware batching
+    (urgent requests flush immediately onto an already-*warm* bucket
+    instead of aging out); and per-tier degradation (the force-gate →
+    bucket-shrink →
+    shed ladder sheds best-effort before touching paid tiers, and
+    ``protect_gate_tiers`` are exempt from the level-1 force-gate).
+    ``slo=None`` (the default) changes nothing — not a record byte, a
+    journal line, a compiled program or a metric family (the same
+    disabled-mode discipline as chaos/flight/mesh).
     """
     from ..engine.sampler import lane_select
     from ..utils import progress as progress_mod
@@ -405,7 +437,7 @@ def serve_forever(
         pipe, progress=progress, validate=validate_outputs,
         heartbeat=watchdog_ms is not None, mesh=jmesh)
     policy = retry_policy or RetryPolicy()
-    queue = AdmissionQueue(queue_cap)
+    queue = AdmissionQueue(queue_cap, slo=slo)
     if max_batch not in BUCKET_SIZES:
         # Validate the PER-DEVICE knob before scaling: the batcher would
         # reject max_batch*dp anyway, but its message would cite dp-scaled
@@ -413,8 +445,19 @@ def serve_forever(
         # input as "valid").
         raise ValueError(f"max_batch must be one of {BUCKET_SIZES}, "
                          f"got {max_batch}")
+    # Under an SloConfig the tier joins the BATCH keys only (never a
+    # compile key): tiers batch apart — a premium lane never waits on
+    # best-effort batchmates — while every tier still shares one compiled
+    # program per bucket. slo=None keeps the historical keys bit-for-bit.
+    main_key_fn = None if slo is None else (
+        lambda e: e.prepared.batch_key + ("tier", slo.tier(e.request)))
+    phase2_key_fn = (
+        (lambda e: e.prepared.phase2_batch_key) if slo is None else
+        (lambda e: e.prepared.phase2_batch_key
+         + ("tier", slo.tier(e.request))))
     batcher = DynamicBatcher(max_batch=max_batch * dp,
-                             max_wait_ms=max_wait_ms, bucket_sizes=sizes)
+                             max_wait_ms=max_wait_ms, bucket_sizes=sizes,
+                             key_fn=main_key_fn)
     if phase2_max_batch is None:
         phase2_max_batch = _wider_bucket(max_batch)
     elif phase2_max_batch not in BUCKET_SIZES:
@@ -422,7 +465,7 @@ def serve_forever(
                          f"got {phase2_max_batch}")
     batcher2 = DynamicBatcher(
         max_batch=phase2_max_batch * dp, max_wait_ms=max_wait_ms,
-        key_fn=lambda e: e.prepared.phase2_batch_key, pool="phase2",
+        key_fn=phase2_key_fn, pool="phase2",
         bucket_sizes=sizes)
     # The cache shares the loop's retry policy: transient *build* failures
     # (prewarm and in-band misses) back off on the wall clock inside the
@@ -469,6 +512,20 @@ def serve_forever(
     last_snapshot_ms = 0.0
     snapshots_taken = 0
     restore_degrade_level = 0
+    # SLO-tiered scheduling state (serve.scheduling). With slo=None all
+    # of this stays inert — `parked`/`forced_preempt` can only fill via a
+    # chaos `preempt_then_kill` plan, which is itself non-default.
+    parked: List[HandoffEntry] = []
+    forced_preempt: set = set()      # chaos preempt_then_kill victims
+    preemptions = 0
+    preempt_resumes = 0
+    deadline_jumps = 0
+    tier_yields = 0
+    quota_rejects = 0
+    tier_by_id: dict = {}
+    slo_tier_counts = ({t: {s: 0 for s in TERMINAL_STATUSES}
+                        for t in scheduling_mod.TIERS}
+                       if slo is not None else {})
 
     # Registry-backed aggregation alongside (never instead of) the JSONL
     # records: the per-request record schema is the stable contract, the
@@ -567,6 +624,17 @@ def serve_forever(
         "serve_draining", "1 while the graceful-drain protocol is active")
     m_drains = reg.counter(
         "serve_drains_total", "graceful-drain protocol entries")
+    # SLO families exist only when the scheduler is active, so an slo-less
+    # run's registry snapshot is byte-identical to the pre-SLO engine's
+    # (the preemption counters are the one exception: a chaos
+    # preempt_then_kill plan creates them on first use via reg get-or-
+    # create inside park()/resume_parked() — chaos is itself non-default).
+    m_tier = None
+    if slo is not None:
+        m_tier = reg.counter(
+            "serve_tier_requests_total",
+            "terminal records of admitted requests by SLO tier and status",
+            labels=("tier", "status"))
     # Mesh families are created (and observed) only when a mesh is active:
     # a mesh-less run's registry snapshot carries no mesh rows at all
     # (the record stream / journal / program halves of disabled-mode
@@ -605,6 +673,14 @@ def serve_forever(
         # split at the phase-2 dispatch site instead.
         counts[status] += 1
         m_requests.labels(status=status).inc()
+        if m_tier is not None and release:
+            # Admitted requests only (rejections with release=False were
+            # never admitted — and a duplicate-id rejection's id belongs
+            # to a still-live original whose tier mapping must survive).
+            tier = tier_by_id.pop(request_id, None)
+            if tier is not None:
+                m_tier.labels(tier=tier, status=status).inc()
+                slo_tier_counts[tier][status] += 1
         if status == "ok" and stage_phase is not None:
             for key, hist in m_stage.items():
                 if key in fields:
@@ -646,6 +722,7 @@ def serve_forever(
                 "outstanding": queue.outstanding,
                 "batcher_waiting": {"main": len(batcher),
                                     "phase2": len(batcher2)},
+                "parked": len(parked),
                 "degrade_level": degrade_level,
                 "draining": draining,
                 "batches_dispatched": batch_index,
@@ -672,6 +749,13 @@ def serve_forever(
         if fault is not None and fault.kind in chaos_mod.LIFECYCLE_KINDS:
             if fault.kind == chaos_mod.SIGTERM:
                 drain_ctl.request(f"chaos:{fault.target}")
+            elif fault.kind == chaos_mod.PREEMPT_THEN_KILL:
+                # The victims park at their next phase boundary (their
+                # hand-off goes to `parked`, not the phase-2 batcher);
+                # the armed kill fires at the first batch-boundary sync
+                # after the park — before any resume can run.
+                forced_preempt.update(fault.rids)
+                chaos.arm_kill(fault.kind)
             else:
                 chaos.arm_kill(fault.kind)
             return None
@@ -771,6 +855,8 @@ def serve_forever(
                                 m_replay.labels(kind="handoff_lost").inc()
                             if carry is not None:
                                 entry = queue.admit_inflight(prep, 0.0)
+                                if slo is not None:
+                                    tier_by_id[rid] = slo.tier(req)
                                 batcher2.add(HandoffEntry(
                                     entry=entry, carry=carry,
                                     handoff_ms=0.0, resumed=True), 0.0)
@@ -785,6 +871,8 @@ def serve_forever(
                                     flight.resume(rid, ho.get("trace"), 0.0)
                                 continue
                         queue.submit(prep, 0.0)
+                        if slo is not None:
+                            tier_by_id[rid] = slo.tier(req)
                         replayed_ids.add(rid)
                         m_replay.labels(kind="pending").inc()
                         if flight is not None:
@@ -1256,10 +1344,163 @@ def serve_forever(
                                        if flight is not None else None))
             handoffs_total += 1
             m_handoffs.inc()
-            batcher2.add(HandoffEntry(entry=e, carry=c, handoff_ms=vnow,
-                                      phase1=p1,
-                                      nan_injected=e.request_id in nan_rids),
-                         vnow)
+            h = HandoffEntry(entry=e, carry=c, handoff_ms=vnow, phase1=p1,
+                             nan_injected=e.request_id in nan_rids)
+            if e.request_id in forced_preempt:
+                # chaos preempt_then_kill: this lane's phase boundary IS
+                # the forced preemption point — park instead of queueing.
+                forced_preempt.discard(e.request_id)
+                park(h, "chaos")
+            else:
+                batcher2.add(h, vnow)
+
+    # ------------------------------------------------------------------
+    # SLO scheduler: phase-boundary preemption (park / resume) and
+    # deadline-aware batching. All of it runs at cycle boundaries on the
+    # virtual clock, so every policy decision is drill-able.
+    # ------------------------------------------------------------------
+
+    def park(e: HandoffEntry, cause: str) -> None:
+        """Preempt one between-phases request: its carry spills to disk
+        via the hand-off path with a journaled ``preempted`` record (the
+        crash copy — a preempted-then-killed request resumes off it
+        exactly like a crashed hand-off), and the entry waits in
+        ``parked`` until pressure clears. The in-memory carry is kept:
+        an in-process resume is bitwise-trivially the same work."""
+        nonlocal preemptions
+        e.preempted_ms = vnow
+        preemptions += 1
+        tier = (slo.tier(e.request) if slo is not None
+                else (getattr(e.request, "tier", None)
+                      or scheduling_mod.TIERS[1]))
+        reg.counter("serve_preemptions_total",
+                    "phase-boundary preemptions by victim tier",
+                    labels=("tier",)).labels(tier=tier).inc()
+        if journal is not None:
+            path = journal.carry_path(e.request_id)
+            spec = handoff_mod.spill_carry(e.carry, path)
+            journal.preempted(e.request_id, vnow, path, spec, tier=tier,
+                              trace=(flight.context(e.request_id)
+                                     if flight is not None else None))
+        if flight is not None:
+            # Close the pre-park hand-off wait here so the parked span
+            # itself lands in its own `preempt_wait` stage at resume.
+            flight.wait(e.request_id, "handoff_wait", vnow, pool="phase2",
+                        preempted=True)
+            flight.event(e.request_id, "preempted", vnow, cause=cause)
+        parked.append(e)
+
+    def resume_parked(reason: str) -> None:
+        nonlocal preempt_resumes
+        if not parked:
+            return
+        for e in parked:
+            if e.preempted_ms is not None:
+                e.preempt_wait_ms += vnow - e.preempted_ms
+                e.preempted_ms = None
+            preempt_resumes += 1
+            reg.counter("serve_preempt_resumes_total",
+                        "parked (preempted) requests resumed into the "
+                        "phase-2 batcher").inc()
+            if flight is not None:
+                flight.wait(e.request_id, "preempt_wait", vnow,
+                            pool="phase2")
+                flight.event(e.request_id, "preempt_resumed", vnow,
+                             reason=reason)
+            batcher2.add(e, vnow)
+        parked.clear()
+
+    def preemption_cycle() -> Iterator[dict]:
+        """One cycle-boundary pass of the preemption policy: resolve
+        parked work that was cancelled or expired while parked (the
+        terminal record's journal write discards the spill — no orphan),
+        park lower-tier phase-2 waiters under pressure, resume when the
+        pressure clears or nothing higher-tier is waiting (a queue made
+        of parked requests must never deadlock itself)."""
+        if parked:
+            still = []
+            for e in parked:
+                if queue.is_cancelled(e.request_id) or \
+                        queue_mod.expired(e, vnow):
+                    if e.preempted_ms is not None:
+                        e.preempt_wait_ms += vnow - e.preempted_ms
+                        e.preempted_ms = None
+                    if queue.is_cancelled(e.request_id):
+                        yield record("cancelled", e.request_id,
+                                     arrival_ms=e.arrival_ms,
+                                     queue_wait_ms=vnow - e.arrival_ms)
+                    else:
+                        yield record(
+                            "expired", e.request_id,
+                            arrival_ms=e.arrival_ms,
+                            reason=(f"deadline {e.request.deadline_ms}ms "
+                                    f"passed while preempted (waited "
+                                    f"{vnow - e.arrival_ms:.1f}ms)"))
+                else:
+                    still.append(e)
+            parked[:] = still
+        if slo is not None and slo.preempt_depth is not None and \
+                not draining and queue.outstanding > slo.preempt_depth:
+            # (never parks while draining: a drain completes in-flight
+            # work, it does not create more of it)
+            ranks = [slo.rank(e.request) for e in batcher.entries()]
+            if ranks:
+                best = min(ranks)
+                for e in batcher2.remove_if(
+                        lambda e: slo.rank(e.request) > best):
+                    park(e, "pressure")
+        if parked:
+            if slo is not None and slo.preempt_depth is not None:
+                min_parked = min(slo.rank(e.request) for e in parked)
+                blocked = (
+                    queue.outstanding > slo.effective_resume_depth
+                    and any(slo.rank(e.request) < min_parked
+                            for e in batcher.entries()))
+            else:
+                blocked = False   # chaos-forced parks: the kill fired (or
+                #                   never will) — resume at this boundary
+            if not blocked:
+                resume_parked("pressure_cleared")
+
+    def jump_urgent(b, compile_key_of) -> List[Batch]:
+        """Deadline-aware batching: a bucket holding an entry whose
+        deadline would expire waiting out ``max_wait`` flushes NOW — but
+        only onto an already-warm program (warm-preference then pads it
+        up to the smallest warm bucket that fits, at dispatch). The jump
+        never pulls a compile in-band: cold buckets age out exactly as
+        before."""
+        nonlocal deadline_jumps
+        out: List[Batch] = []
+        for key in b.waiting_keys():
+            group = b.group(key)
+            if len(group) >= b.max_batch:
+                continue               # full: flushes this cycle anyway
+            flush_at = b.group_flush_at(key)
+            if flush_at is None or flush_at <= vnow:
+                continue               # aged out: flushes this cycle
+            if not any(e.deadline_at is not None
+                       and vnow <= e.deadline_at < flush_at
+                       for e in group):
+                continue
+            ck = compile_key_of(group[0])
+            if _warm_bucket(len(group), ck, b.max_batch, cache,
+                            sizes) is None:
+                continue
+            jumped = b.flush_key(key, vnow)
+            deadline_jumps += len(jumped)
+            reg.counter("serve_deadline_jumps_total",
+                        "urgent buckets flushed onto a warm program "
+                        "ahead of max_wait").inc(len(jumped))
+            out.extend(jumped)
+        return out
+
+    def _ck_main(e):
+        prep = e.prepared
+        return mkey(prep.phase1_key if (prep.gated and phase_pools)
+                    else prep.compile_key)
+
+    def _ck_phase2(e):
+        return mkey(e.prepared.phase2_key)
 
     def dispatch_phase1(batch: Batch) -> Iterator[dict]:
         nonlocal vnow, batch_index, retries_total
@@ -1461,7 +1702,12 @@ def serve_forever(
         latency = vnow - e.arrival_ms
         latencies.append(latency)
         p1 = e.phase1
-        handoff_wait = dispatch_ms - e.handoff_ms
+        # The parked (preempted) span is split OUT of the hand-off wait:
+        # the record's phases detail and the phase-2 queue-wait histogram
+        # attribute the scheduler's milliseconds to preempt_wait_ms, not
+        # to the batcher — the same split the flight tracer makes.
+        handoff_wait = max(0.0, dispatch_ms - e.handoff_ms
+                           - e.preempt_wait_ms)
         phases: dict = {
             "handoff_wait_ms": handoff_wait,
             "phase2": {"batch_id": this_batch, "lanes": bucket,
@@ -1481,6 +1727,12 @@ def serve_forever(
             phases["phase1"] = {"resumed": True}
         if e.resumed:
             phases["resumed"] = True
+        if e.preempt_wait_ms:
+            # This request was preempted at the phase boundary and parked;
+            # the parked span is split out of the hand-off wait so latency
+            # attribution names the scheduler, not the batcher.
+            phases["preempted"] = True
+            phases["preempt_wait_ms"] = e.preempt_wait_ms
         stage["queue_wait_ms"].labels(phase="phase2").observe(handoff_wait)
         stage["compile_ms"].labels(phase="phase2").observe(compile_ms)
         stage["run_ms"].labels(phase="phase2").observe(run_ms)
@@ -1811,6 +2063,9 @@ def serve_forever(
                               vnow_ms=round(vnow, 3))
             if flight is not None:
                 flight.loop_event("drain", vnow, reason=drain_ctl.reason)
+            # Parked (preempted) work is in-flight work: a graceful drain
+            # completes it, so it re-enters the phase-2 batcher now.
+            resume_parked("draining")
         # 1. Admit everything that has arrived by now.
         while trace.peek() is not None and \
                 getattr(trace.peek(), "arrival_ms", vnow) <= vnow:
@@ -1838,7 +2093,9 @@ def serve_forever(
                     reason=f"server draining ({drain_ctl.reason}); "
                            f"resubmit after restart")
                 continue
-            forced_gate = degrade_level >= 1 and item.gate is None
+            forced_gate = degrade_level >= 1 and item.gate is None and \
+                (slo is None
+                 or slo.tier(item) not in slo.protect_gate_tiers)
             if forced_gate:
                 # Level 1+: cheaper phase-2 sampling instead of rejections
                 # — approximate results are the graceful part.
@@ -1846,6 +2103,8 @@ def serve_forever(
             try:
                 prep = prepare(item, pipe)
                 queue.submit(prep, vnow)
+                if slo is not None:
+                    tier_by_id[item.request_id] = slo.tier(item)
                 if forced_gate:
                     # Counted only on successful admission: a rejected
                     # request was never force-gated, it never ran.
@@ -1865,6 +2124,8 @@ def serve_forever(
                 # spec validation is "invalid_spec".
                 kind = getattr(e, "kind", "invalid_spec")
                 m_rejects.labels(kind=kind).inc()
+                if kind == "quota":
+                    quota_rejects += 1
                 yield record("rejected", item.request_id, release=False,
                              journal_write=(kind != "duplicate_id"),
                              arrival_ms=item.arrival_ms, reason=reason)
@@ -1876,9 +2137,38 @@ def serve_forever(
         if degrade is not None and degrade_level >= 3:
             overshoot = queue.outstanding - degrade.depth_threshold
             if overshoot > 0:
-                by_value = sorted(
-                    drained, key=lambda e: (e.request.priority, -e.seq))
-                victims = {id(e) for e in by_value[:overshoot]}
+                if slo is None:
+                    by_value = sorted(
+                        drained, key=lambda e: (e.request.priority, -e.seq))
+                    victims = {id(e) for e in by_value[:overshoot]}
+                else:
+                    # Per-tier degradation: only the WORST tier present
+                    # anywhere undispatched (this drain, both batchers,
+                    # parked work) is sheddable — a paid tier is touched
+                    # only when nothing lower remains at all. Victims
+                    # come from the admission side (drain + main
+                    # batcher): phase-2/parked work is past its phase-1
+                    # compute and is preemption's job, not the shed's —
+                    # but its presence still shields paid tiers.
+                    pool = drained + list(batcher.entries())
+                    present = (pool + parked + list(batcher2.entries()))
+                    if pool:
+                        worst = max(slo.rank(e.request) for e in present)
+                        by_value = sorted(
+                            (e for e in pool
+                             if slo.rank(e.request) == worst),
+                            key=lambda e: (e.request.priority, -e.seq))
+                        victims = {id(e) for e in by_value[:overshoot]}
+                        for entry in batcher.remove_if(
+                                lambda e: id(e) in victims):
+                            m_shed.inc()
+                            yield record(
+                                "shed", entry.request_id,
+                                arrival_ms=entry.arrival_ms,
+                                reason=(f"load shed at degradation level "
+                                        f"{degrade_level}: outstanding "
+                                        f"{queue.outstanding} > threshold "
+                                        f"{degrade.depth_threshold}"))
         for entry in drained:
             if id(entry) in victims:
                 m_shed.inc()
@@ -1890,12 +2180,21 @@ def serve_forever(
                             f"{degrade.depth_threshold}"))
             else:
                 batcher.add(entry, vnow)
+        # 2.5 Preemption policy at the cycle boundary: cancel/expire
+        # parked work, park lower-tier phase-2 waiters under pressure,
+        # resume when it clears (a no-op without an SloConfig or a chaos
+        # forced preemption).
+        yield from preemption_cycle()
         # 3. Flush whatever is due — phase-2 pool first: finishing
         # nearly-done requests frees outstanding slots and bounds their
         # p95 before new phase-1 work starts (the continuous-batching
-        # priority).
+        # priority). Deadline-urgent buckets jump the age-out onto warm
+        # programs (serve.scheduling).
         batches2 = batcher2.ready(vnow)
         batches = batcher.ready(vnow)
+        if slo is not None and slo.deadline_jump:
+            batches2 += jump_urgent(batcher2, _ck_phase2)
+            batches += jump_urgent(batcher, _ck_main)
         if not batches and not batches2:
             if journal is not None:
                 journal.sync()  # going idle: everything admitted is durable
@@ -1911,13 +2210,24 @@ def serve_forever(
                 continue
             # Trace done (or draining): drain both tails (hand-offs
             # produced by the phase-1 tail re-enter via the next loop
-            # iteration).
+            # iteration). Parked work resumes first — the pipeline is not
+            # empty while a preempted request still holds a carry.
+            if parked:
+                resume_parked("pipeline_drained")
             batches2 = batcher2.flush_all(vnow)
             batches = batcher.flush_all(vnow)
             if not batches and not batches2:
                 break
         ordered = ([("phase2", b) for b in batches2]
                    + [("phase1", b) for b in batches])
+        if slo is not None:
+            # Tier-pure batches dispatch best tier first within each
+            # pool; the phase-2 pool keeps its head start (finish
+            # nearly-done work), and admission order breaks ties.
+            ordered.sort(key=lambda pb: (
+                0 if pb[0] == "phase2" else 1,
+                min(slo.rank(e.request) for e in pb[1].entries),
+                min(e.seq for e in pb[1].entries)))
         for bi, (pool, batch) in enumerate(ordered):
             if draining and drain_timeout_ms is not None and \
                     (timer() - drain_wall0) * 1000.0 > drain_timeout_ms:
@@ -1933,6 +2243,8 @@ def serve_forever(
                              for e in b.entries]
                 leftover += [e for b in batcher2.flush_all(vnow)
                              for e in b.entries]
+                leftover += parked
+                parked.clear()
                 leftover += queue.drain()
                 if journal is not None:
                     journal.event("drain_timeout", pending=len(leftover),
@@ -1960,6 +2272,37 @@ def serve_forever(
                 if journal is not None:
                     journal.sync()
                 raise chaos_mod.SimulatedKill("chaos kill_during_drain")
+            if slo is not None and not draining and \
+                    fatal_reason[0] is None and bi + 1 < len(ordered):
+                # Dispatch-boundary tier yield: a higher-tier request has
+                # ARRIVED (virtual time moved under this batch) while
+                # every remaining batch this cycle is lower-tier — hand
+                # the cycle back to admission instead of making the
+                # arrival wait out the whole backlog. The remaining
+                # entries re-enter their batchers (their buckets re-form
+                # next cycle); under sustained higher-tier pressure the
+                # ladder sheds them rather than starving them silently.
+                nxt = trace.peek()
+                if isinstance(nxt, Request) and nxt.arrival_ms <= vnow:
+                    pending_rank = scheduling_mod.tier_rank(slo.tier(nxt))
+                    rest = ordered[bi + 1:]
+                    # Urgent (deadline-jumped) batches keep their dispatch
+                    # slot: re-queueing one would void the jump it already
+                    # took (its deadline can expire during the yielded
+                    # cycle) and count the same jump again next cycle.
+                    yieldable = [pb for pb in rest if not pb[1].urgent]
+                    if yieldable and min(
+                            min(slo.rank(e.request) for e in b.entries)
+                            for _, b in yieldable) > pending_rank:
+                        tier_yields += 1
+                        for pool_name, b in yieldable:
+                            for e in b.entries:
+                                (batcher2 if pool_name == "phase2"
+                                 else batcher).add(e, vnow)
+                        if len(yieldable) == len(rest):
+                            break
+                        ordered[bi + 1:] = [pb for pb in rest
+                                            if pb[1].urgent]
             if fatal_reason[0] is not None:
                 # Fatal fault: drain cleanly — terminal records for every
                 # outstanding request, then the summary. Nothing is left
@@ -1972,6 +2315,8 @@ def serve_forever(
                              for e in b.entries]
                 leftover += [e for b in batcher2.flush_all(vnow)
                              for e in b.entries]
+                leftover += parked
+                parked.clear()
                 leftover += queue.drain()
                 for e in leftover:
                     yield record(
@@ -1998,6 +2343,16 @@ def serve_forever(
                 break
         if journal is not None:
             journal.sync()  # batch boundary: the fsync point
+        if chaos is not None and \
+                chaos.take_kill(chaos_mod.PREEMPT_THEN_KILL):
+            # preempt_then_kill's second half: die at the first batch
+            # boundary after the forced preemption — terminals and the
+            # `preempted` record are durable (sync above), the parked
+            # request has NOT resumed. The restart folds the preempted
+            # record like a crashed hand-off and resumes in phase 2 off
+            # the spill, exactly-once.
+            raise chaos_mod.SimulatedKill("chaos preempt_then_kill")
+        if journal is not None:
             if snapshot_every_ms is not None and not draining and \
                     vnow - last_snapshot_ms >= snapshot_every_ms:
                 # Periodic snapshot+compaction on the virtual clock, at
@@ -2101,6 +2456,19 @@ def serve_forever(
             "devices": [int(d) for d in _mesh_dev_ids],
             "max_batch_per_device": max_batch,
             "phase2_max_batch_per_device": phase2_max_batch,
+        }
+    if slo is not None:
+        # Present only under an active SloConfig, so slo-less summaries
+        # stay byte-identical (disabled-mode parity).
+        summary["slo"] = {
+            "tiers": {t: {s: n for s, n in c.items() if n}
+                      for t, c in slo_tier_counts.items()
+                      if any(c.values())},
+            "preemptions": preemptions,
+            "preempt_resumes": preempt_resumes,
+            "deadline_jumps": deadline_jumps,
+            "tier_yields": tier_yields,
+            "quota_rejects": quota_rejects,
         }
     if replay_info is not None:
         summary["replay"] = replay_info

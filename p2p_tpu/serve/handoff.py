@@ -54,6 +54,15 @@ class HandoffEntry:
     #: converts the lane to `invalid_output` at phase 2 — matching the
     #: monolithic engine, where the same injection poisons the one batch.
     nan_injected: bool = False
+    #: SLO preemption bookkeeping (serve.scheduling): when this entry was
+    #: last parked (None = not currently parked) and the total virtual
+    #: time it has spent parked — surfaced in the record's ``phases``
+    #: detail and attributed as the flight tracer's ``preempt_wait``
+    #: stage. The in-memory carry survives a park (the journal spill is
+    #: the *crash* copy, not the working copy), so an in-process resume
+    #: is trivially bitwise.
+    preempted_ms: Optional[float] = None
+    preempt_wait_ms: float = 0.0
 
     @property
     def prepared(self):

@@ -67,6 +67,12 @@ from typing import Callable, Dict, List, Optional
 ADMITTED = "admitted"
 DISPATCHED = "dispatched"
 HANDOFF = "handoff"
+#: A mid-trajectory request was *preempted* at the phase boundary: its
+#: carry is parked on disk (same spill machinery as ``handoff``) until
+#: pressure clears. Replay folds it exactly like a hand-off — a
+#: preempted-then-killed request resumes in phase 2 off the spill, the
+#: same fold, the same exactly-once contract (docs/SERVING.md).
+PREEMPTED = "preempted"
 TERMINAL = "terminal"
 EVENT = "event"
 
@@ -260,7 +266,9 @@ def replay(path: str, *, sweep: bool = True) -> ReplayState:
                         state.duplicate_terminals += 1
                     else:
                         state.terminal[rid] = status
-                elif kind == HANDOFF:
+                elif kind in (HANDOFF, PREEMPTED):
+                    # A preempted record is a hand-off the scheduler made
+                    # early: same spill, same resume point, same fold.
                     rid = rec.get("id")
                     if not rid or not rec.get("carry_path"):
                         state.skipped_corrupt += 1
@@ -339,6 +347,22 @@ class Journal:
         rec = {"type": HANDOFF, "id": request_id,
                "carry_path": carry_path, "spec": spec,
                "vnow_ms": round(vnow, 3)}
+        if trace is not None:
+            rec["trace"] = trace
+        self._append(rec)
+
+    def preempted(self, request_id: str, vnow: float, carry_path: str,
+                  spec: str, tier: str = None, trace: dict = None) -> None:
+        """One request was preempted at the phase boundary (its carry is
+        parked at ``carry_path``, durably spilled, matching ``spec``).
+        Schema = the ``handoff`` record plus the victim's ``tier`` —
+        replay folds the two identically, so a preempted-then-killed
+        request resumes exactly like a crashed hand-off."""
+        rec = {"type": PREEMPTED, "id": request_id,
+               "carry_path": carry_path, "spec": spec,
+               "vnow_ms": round(vnow, 3)}
+        if tier is not None:
+            rec["tier"] = tier
         if trace is not None:
             rec["trace"] = trace
         self._append(rec)

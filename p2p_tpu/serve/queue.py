@@ -19,6 +19,7 @@ import dataclasses
 from typing import Dict, List, Optional
 
 from ..obs import metrics as obs_metrics
+from . import scheduling
 from .request import PreparedRequest
 
 
@@ -41,6 +42,7 @@ class Entry:
     arrival_ms: float
     seq: int = 0                 # admission order (stable sort tiebreak)
     dispatch_ms: Optional[float] = None
+    ftag: float = 0.0            # weighted-fair finish tag (SLO mode only)
 
     @property
     def request(self):
@@ -70,12 +72,23 @@ class AdmissionQueue:
     (priority desc, arrival, admission order) while they stay *outstanding*
     until the engine resolves them via ``release`` — that is what makes the
     capacity a bound on the whole undispatched pipeline, not just this
-    deque."""
+    deque.
 
-    def __init__(self, capacity: int):
+    ``slo`` (a :class:`~p2p_tpu.serve.scheduling.SloConfig`, default None)
+    enables the SLO-tiered layer: per-tenant outstanding quotas (checked
+    before global capacity — the more specific verdict wins, pinned by
+    tests/test_slo.py — with the new reject kind ``quota``) and
+    weighted-fair drain ordering (tier rank, then priority, then the
+    tenants' fair-clock finish tags). ``slo=None`` leaves every byte of
+    the original behavior in place."""
+
+    def __init__(self, capacity: int, slo=None):
         if capacity < 1:
             raise ValueError(f"queue capacity must be >= 1, got {capacity}")
         self.capacity = capacity
+        self.slo = slo
+        self._fair = scheduling.FairClock() if slo is not None else None
+        self._tenant_out: Dict[str, int] = {}
         self._waiting: List[Entry] = []
         self._outstanding: Dict[str, Entry] = {}
         self._cancelled: set = set()
@@ -108,6 +121,17 @@ class AdmissionQueue:
         if rid in self._outstanding:
             raise Rejected(f"duplicate request_id {rid!r} still in flight",
                            kind="duplicate_id")
+        tenant = getattr(prepared.request, "tenant", None)
+        if self.slo is not None and self.slo.tenant_quota is not None \
+                and tenant is not None \
+                and self._tenant_out.get(tenant, 0) >= self.slo.tenant_quota:
+            # Checked BEFORE global capacity: when both bounds are blown
+            # the tenant's own quota is the actionable verdict (backing
+            # off that tenant helps; "retry later" does not) — precedence
+            # pinned by tests/test_slo.py.
+            raise Rejected(
+                f"tenant {tenant!r} at quota "
+                f"({self.slo.tenant_quota} outstanding)", kind="quota")
         if len(self._outstanding) >= self.capacity:
             raise Rejected(
                 f"queue full ({self.capacity} outstanding); retry later",
@@ -120,6 +144,12 @@ class AdmissionQueue:
         entry = Entry(prepared=prepared,
                       arrival_ms=max(0.0, prepared.request.arrival_ms),
                       seq=self._seq)
+        if self.slo is not None:
+            entry.ftag = self._fair.tag(
+                tenant, self.slo.weight(self.slo.tier(prepared.request)))
+            if tenant is not None:
+                self._tenant_out[tenant] = \
+                    self._tenant_out.get(tenant, 0) + 1
         self._waiting.append(entry)
         self._outstanding[rid] = entry
         self._m_admitted.inc()
@@ -155,15 +185,34 @@ class AdmissionQueue:
 
     def drain(self) -> List[Entry]:
         """Pop every waiting entry for the batcher, highest priority first
-        (FIFO within a priority level). Entries remain outstanding."""
-        out = sorted(self._waiting,
-                     key=lambda e: (-e.request.priority, e.arrival_ms, e.seq))
+        (FIFO within a priority level). Entries remain outstanding.
+
+        Under an :class:`~p2p_tpu.serve.scheduling.SloConfig` the order is
+        tier rank first (premium before best-effort), then priority
+        within the tier, then the weighted-fair finish tag across
+        tenants, then arrival/admission order."""
+        if self.slo is None:
+            out = sorted(self._waiting,
+                         key=lambda e: (-e.request.priority, e.arrival_ms,
+                                        e.seq))
+        else:
+            out = sorted(self._waiting,
+                         key=lambda e: (self.slo.rank(e.request),
+                                        -e.request.priority, e.ftag,
+                                        e.arrival_ms, e.seq))
         self._waiting = []
         self._update_gauges()
         return out
 
     def release(self, request_id: str) -> None:
-        """Resolve one admitted request (record emitted); frees capacity."""
-        self._outstanding.pop(request_id, None)
+        """Resolve one admitted request (record emitted); frees capacity
+        (and the tenant's quota slot)."""
+        entry = self._outstanding.pop(request_id, None)
+        if entry is not None and self.slo is not None:
+            tenant = getattr(entry.request, "tenant", None)
+            if tenant is not None and tenant in self._tenant_out:
+                self._tenant_out[tenant] -= 1
+                if self._tenant_out[tenant] <= 0:
+                    del self._tenant_out[tenant]
         self._cancelled.discard(request_id)
         self._update_gauges()

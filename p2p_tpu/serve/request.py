@@ -46,6 +46,8 @@ import dataclasses
 import json
 from typing import Any, Optional, Tuple
 
+from . import scheduling
+
 _SCHEDULERS = ("ddim", "plms", "dpm")
 _MODES = ("replace", "refine")
 
@@ -73,7 +75,13 @@ class Request:
     gate: Any = None            # None | 'auto' | float fraction | int step
     arrival_ms: float = 0.0     # virtual trace time (loadgen / replay)
     deadline_ms: Optional[float] = None  # relative to arrival; None = none
-    priority: int = 0           # higher dispatches first
+    priority: int = 0           # higher dispatches first (within a tier)
+    # SLO scheduling metadata (serve.scheduling): who the request belongs
+    # to and what latency class it bought. Pure scheduler inputs — they
+    # never join a compile key (tiers must not fragment programs) and,
+    # absent, the whole SLO layer is byte-invisible (to_dict drops None).
+    tenant: Optional[str] = None   # quota/fair-share identity
+    tier: Optional[str] = None     # one of scheduling.TIERS
 
     @property
     def prompts(self) -> Tuple[str, ...]:
@@ -141,6 +149,27 @@ def _structural_validate(req: Request) -> None:
     if isinstance(req.gate, str) and req.gate != "auto":
         raise ValueError(f"gate must be null, 'auto', a fraction or a step "
                          f"index, got {req.gate!r}")
+    # Scheduling metadata is validated HERE, at admission, so a bad value
+    # is a clean schema reject — never a TypeError inside the queue's sort
+    # comparator three stages later (bool is an int subclass and would
+    # sort, but it is always a caller bug: rejected explicitly).
+    if isinstance(req.priority, bool) or not isinstance(req.priority, int):
+        raise ValueError(f"priority must be an int, "
+                         f"got {type(req.priority).__name__} "
+                         f"{req.priority!r}")
+    if abs(req.priority) > scheduling.PRIORITY_BOUND:
+        raise ValueError(f"priority must be within "
+                         f"±{scheduling.PRIORITY_BOUND}, got {req.priority}")
+    if req.tenant is not None:
+        if not isinstance(req.tenant, str) or not req.tenant:
+            raise ValueError(f"tenant must be a non-empty string, "
+                             f"got {req.tenant!r}")
+        if len(req.tenant) > scheduling.TENANT_MAX_LEN:
+            raise ValueError(f"tenant id longer than "
+                             f"{scheduling.TENANT_MAX_LEN} chars")
+    if req.tier is not None and req.tier not in scheduling.TIERS:
+        raise ValueError(f"unknown tier {req.tier!r}; valid: "
+                         f"{', '.join(scheduling.TIERS)}")
 
 
 def controller_signature(controller) -> Tuple:

@@ -1,0 +1,176 @@
+"""SLO-tiered multi-tenant scheduling policy for the serve loop.
+
+One FIFO cannot protect premium latency under overload: at millions of
+users the engine needs *priority classes*, and the two-pool phase
+structure already gives it a preemption point for free (the phase-1 →
+phase-2 hand-off is a serializable suspension point whose carry the
+journal can spill). This module is the policy vocabulary that the rest of
+the stack shares — it deliberately imports nothing from the serve package
+so ``request``/``queue``/``engine_loop`` can all depend on it:
+
+- :data:`TIERS` — the closed, ordered set of SLO tiers (best first).
+  Bounded by construction: tier is a metric label and a batch-key
+  component, so free-text tiers would be unbounded cardinality and
+  unbounded program fragmentation.
+- :class:`SloConfig` — the scheduler knobs: per-tier weights for
+  weighted-fair queuing across tenants, per-tenant outstanding quotas
+  (reject kind ``quota``), the phase-boundary preemption thresholds,
+  deadline-aware batching (urgent requests flush immediately onto an
+  already-warm bucket), and which tiers the degradation ladder must not
+  force-gate.
+- :class:`FairClock` — deterministic start-time fair queuing: each
+  admitted request gets a finish tag ``vtime[tenant] += 1/weight``; the
+  queue drains tiers strictly in rank order and, within a tier, tenants
+  in finish-tag order — a tenant flooding the queue advances its own
+  virtual time and yields to lighter tenants, weighted by tier.
+
+Scheduling metadata NEVER joins a compile key (tiers must not fragment
+compiled programs); under an active :class:`SloConfig` the tier joins the
+*batch* key only (``engine_loop`` appends it to the batcher ``key_fn``),
+so premium lanes never ride behind best-effort batchmates while every
+tier still shares one compiled program per bucket. ``slo=None`` (the
+default everywhere) is the disabled mode: not a key, a record byte or a
+metric family changes — the same discipline as chaos/flight/mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+#: Ordered SLO tiers, best (most protected) first. The index is the tier's
+#: rank: lower rank dispatches first, higher rank sheds first.
+TIERS = ("premium", "standard", "best_effort")
+
+#: ``Request.priority`` must be an int in ``[-PRIORITY_BOUND,
+#: PRIORITY_BOUND]`` — validated at admission (schema reject), never
+#: discovered as a ``TypeError`` inside the queue's sort comparator.
+PRIORITY_BOUND = 1_000_000
+
+#: ``Request.tenant`` length cap: tenant ids are caller-chosen free text
+#: that flows into quota bookkeeping; a cap keeps a hostile id from
+#: becoming a memory/log weapon (the id itself is never a metric label).
+TENANT_MAX_LEN = 128
+
+_DEFAULT_WEIGHTS = (("premium", 4.0), ("standard", 2.0), ("best_effort", 1.0))
+
+
+def tier_rank(tier: str) -> int:
+    """Rank of a tier (0 = most protected). Raises on unknown tiers —
+    the schema validated them at admission, so an unknown tier here is a
+    programming error, not traffic."""
+    return TIERS.index(tier)
+
+
+@dataclasses.dataclass(frozen=True)
+class SloConfig:
+    """Scheduler policy for one serve loop. Everything defaults to the
+    mildest useful behavior; ``serve_forever(slo=None)`` (the default)
+    disables the whole layer.
+
+    - ``tenant_quota`` — max *outstanding* (admitted, unresolved)
+      requests per named tenant; excess submissions reject with kind
+      ``quota``. Requests without a ``tenant`` field are never
+      quota-limited (they are not a tenant).
+    - ``preempt_depth`` — when ``queue.outstanding`` exceeds this while
+      strictly higher-tier work waits for the batcher, lower-tier
+      requests parked between their phases (waiting in the phase-2
+      batcher) are preempted: their carry is spilled via the journal's
+      hand-off path with a ``preempted`` WAL record, and they resume
+      when the pressure clears. ``None`` disables preemption.
+    - ``resume_depth`` — outstanding depth at/below which parked work
+      resumes (default: ``preempt_depth``). Parked work also resumes
+      whenever no higher-tier work is waiting, so a queue made of parked
+      requests can never deadlock itself.
+    - ``deadline_jump`` — urgent requests (deadline would expire waiting
+      out ``max_wait_ms``) flush immediately onto an already-warm bucket
+      (the smallest warm one that fits, via warm-preference) instead of
+      aging out; never pulls a compile in-band (the jump only fires when
+      a warm program already covers the group).
+    - ``weights`` — per-tier weighted-fair share across tenants.
+    - ``protect_gate_tiers`` — tiers exempt from the level-1 degradation
+      force-gate (paid tiers keep full-quality sampling; best-effort
+      absorbs the approximation first, exactly as it absorbs the shed).
+    - ``default_tier`` — the tier of requests that carry none.
+    """
+
+    tenant_quota: Optional[int] = None
+    preempt_depth: Optional[int] = None
+    resume_depth: Optional[int] = None
+    deadline_jump: bool = True
+    weights: Tuple[Tuple[str, float], ...] = _DEFAULT_WEIGHTS
+    protect_gate_tiers: Tuple[str, ...] = ("premium",)
+    default_tier: str = "standard"
+
+    def __post_init__(self):
+        if self.tenant_quota is not None and self.tenant_quota < 1:
+            raise ValueError(f"tenant_quota must be >= 1, "
+                             f"got {self.tenant_quota}")
+        if self.preempt_depth is not None and self.preempt_depth < 1:
+            raise ValueError(f"preempt_depth must be >= 1, "
+                             f"got {self.preempt_depth}")
+        if self.resume_depth is not None:
+            if self.preempt_depth is None:
+                raise ValueError("resume_depth needs preempt_depth")
+            if not 0 <= self.resume_depth <= self.preempt_depth:
+                raise ValueError(
+                    f"resume_depth must be in [0, preempt_depth="
+                    f"{self.preempt_depth}], got {self.resume_depth}")
+        if self.default_tier not in TIERS:
+            raise ValueError(f"default_tier must be one of {TIERS}, "
+                             f"got {self.default_tier!r}")
+        seen = dict(self.weights)
+        for t, w in self.weights:
+            if t not in TIERS:
+                raise ValueError(f"unknown tier {t!r} in weights; "
+                                 f"valid: {TIERS}")
+            if w <= 0:
+                raise ValueError(f"tier weight must be positive, "
+                                 f"got {t}={w}")
+        for t in self.protect_gate_tiers:
+            if t not in TIERS:
+                raise ValueError(f"unknown tier {t!r} in "
+                                 f"protect_gate_tiers; valid: {TIERS}")
+        object.__setattr__(self, "_weight_map", seen)
+
+    # -- request-facing helpers -------------------------------------------
+    def tier(self, req) -> str:
+        """The request's effective tier (its field, or the default)."""
+        return getattr(req, "tier", None) or self.default_tier
+
+    def rank(self, req) -> int:
+        return tier_rank(self.tier(req))
+
+    def weight(self, tier: str) -> float:
+        return self._weight_map.get(tier, 1.0)
+
+    @property
+    def effective_resume_depth(self) -> Optional[int]:
+        if self.preempt_depth is None:
+            return None
+        return (self.preempt_depth if self.resume_depth is None
+                else self.resume_depth)
+
+
+class FairClock:
+    """Deterministic start-time fair queuing over tenants.
+
+    ``tag(tenant, weight)`` charges ``1/weight`` of virtual service to
+    the tenant and returns its new virtual finish time — the admission
+    queue sorts same-tier entries by this tag, so a heavy tenant's
+    requests interleave with (rather than starve) lighter tenants', in
+    proportion to their tier weights. Tenant-less requests share one
+    anonymous lane (they are already globally FIFO within their tier).
+    Purely arithmetic: same admission order ⇒ same tags, byte-stable
+    drills."""
+
+    _ANON = ""
+
+    def __init__(self):
+        self._vtime: Dict[str, float] = {}
+
+    def tag(self, tenant: Optional[str], weight: float) -> float:
+        key = tenant if tenant is not None else self._ANON
+        ft = self._vtime.get(key, 0.0) + 1.0 / max(weight, 1e-9)
+        self._vtime[key] = ft
+        return ft

@@ -73,14 +73,28 @@ SERVE_MESH_KEYS = {
 }
 
 
+#: ISSUE 12: the serve block's `slo` sub-record — the SLO-tiered 2×
+#: overload drill on the deterministic virtual clock. Frozen literal:
+#: premium_p99_ratio is a benchwatch headline key (lower is better,
+#: bound 1.2× by the quality gate's `slo` check), and the shed split
+#: records that best-effort absorbed the overload.
+SERVE_SLO_KEYS = {
+    "n_requests", "overload_factor",
+    "premium_p99_ms", "premium_uncontended_p99_ms", "premium_p99_ratio",
+    "best_effort_shed", "paid_shed",
+    "preemptions", "preempt_resumes", "quota_rejects",
+}
+
+
 def test_rehearsal_schema_unchanged_by_static_analysis_pr():
-    """ISSUE 5 was a static-analysis PR, ISSUE 6 a serve-architecture PR
-    and ISSUE 10 a mesh-serving PR: the top-level rehearsal schema stays
-    exactly the PR-4 set (ISSUE 6 grows the serve block's NESTED `phases`
-    sub-record — SERVE_PHASES_KEYS — and ISSUE 10 its NESTED `mesh`
-    sub-record — SERVE_MESH_KEYS). A future PR that grows the schema
-    updates the frozen copies (and EXPECTED_KEYS, and bench._BLOCK_KEYS)
-    in the same diff, deliberately."""
+    """ISSUE 5 was a static-analysis PR, ISSUE 6 a serve-architecture PR,
+    ISSUE 10 a mesh-serving PR and ISSUE 12 an SLO-scheduling PR: the
+    top-level rehearsal schema stays exactly the PR-4 set (ISSUE 6 grows
+    the serve block's NESTED `phases` sub-record — SERVE_PHASES_KEYS —
+    ISSUE 10 its NESTED `mesh` sub-record — SERVE_MESH_KEYS — and
+    ISSUE 12 its NESTED `slo` sub-record — SERVE_SLO_KEYS). A future PR
+    that grows the schema updates the frozen copies (and EXPECTED_KEYS,
+    and bench._BLOCK_KEYS) in the same diff, deliberately."""
     assert EXPECTED_KEYS == {
         "metric", "value", "unit", "vs_baseline", "variant", "platform",
         "single_group_imgs_per_s",
@@ -569,6 +583,19 @@ def test_bench_rehearsal_green_and_complete():
     # dp-scaled buckets, and recorded the devices axis + scaling keys the
     # chip window will measure. Like the phases A/B, the CPU-rehearsal
     # scaling ratio is recorded, not thresholded (linear batch cost).
+    # SLO-tiered overload protection acceptance (ISSUE 12): the 2x
+    # overload drill held the premium p99 bound with best-effort
+    # absorbing every shed, the quota and preemption machinery actually
+    # fired, and the sub-record carries exactly the frozen keys the
+    # benchwatch headline (serve.slo.premium_p99_ratio) reads.
+    sb = doc["serve"]["slo"]
+    assert set(sb) == SERVE_SLO_KEYS
+    assert sb["overload_factor"] >= 2.0
+    assert sb["premium_p99_ratio"] <= 1.2
+    assert sb["best_effort_shed"] >= 1
+    assert sb["paid_shed"] == 0
+    assert sb["preemptions"] >= 1
+    assert sb["quota_rejects"] >= 1
     mb = doc["serve"]["mesh"]
     assert set(mb) == SERVE_MESH_KEYS
     assert mb["devices"] >= 2            # the virtual mesh really spanned

@@ -76,6 +76,29 @@ def test_lower_is_better_direction(benchwatch, tmp_path):
     assert by["phase2_ms_per_step"]["status"] == "improved"   # dropped
 
 
+def test_slo_premium_p99_ratio_direction(benchwatch, tmp_path):
+    """ISSUE 12 rehearsal: serve.slo.premium_p99_ratio is a headline key
+    watched direction-aware (lower is better) — a round where premium p99
+    drifts past its uncontended baseline by more than the threshold fails
+    the watch, and an improving ratio reads as improved."""
+    _round(tmp_path, 1, _parsed(1.0, serve={"slo": {
+        "premium_p99_ratio": 1.0}}))
+    _round(tmp_path, 2, _parsed(1.0, serve={"slo": {
+        "premium_p99_ratio": 1.3}}))   # +30% the wrong way
+    report = benchwatch.watch(str(tmp_path), 0.10)
+    by = {r["key"]: r for r in report["rows"]}
+    assert by["serve.slo.premium_p99_ratio"]["status"] == "REGRESSION"
+    assert [r["key"] for r in report["regressions"]] == [
+        "serve.slo.premium_p99_ratio"]
+    assert benchwatch.main(["--root", str(tmp_path)]) == 1
+    _round(tmp_path, 3, _parsed(1.0, serve={"slo": {
+        "premium_p99_ratio": 0.99}}))
+    report = benchwatch.watch(str(tmp_path), 0.10)
+    by = {r["key"]: r for r in report["rows"]}
+    assert by["serve.slo.premium_p99_ratio"]["status"] == "improved"
+    assert not report["regressions"]
+
+
 def test_metric_change_is_not_comparable(benchwatch, tmp_path):
     """An on-chip round after CPU-fallback rounds (the committed r05
     shape) must not diff a preset change as a regression."""

@@ -1,0 +1,469 @@
+"""SLO-tiered multi-tenant scheduling (ISSUE 12): weighted-fair
+admission, per-tenant quotas, phase-boundary preemption, deadline-aware
+batching, per-tier degradation — and the disabled-mode parity contract.
+
+Control-flow properties run against injected runners and a virtual timer
+(the engine's event loop is deterministic given a trace); the durability
+and numerics halves (preempt-then-kill resume off the spill, deadline
+jump bitwise, the dp=2 mesh leg) run real tiny-pipeline runners.
+"""
+
+import importlib.util
+import json
+import os
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from p2p_tpu.serve import (
+    AdmissionQueue,
+    Journal,
+    Rejected,
+    Request,
+    SloConfig,
+    TIERS,
+    prepare,
+    serve_forever,
+)
+from p2p_tpu.serve.scheduling import FairClock
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _chaos_drill():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_drill", os.path.join(REPO, "tools", "chaos_drill.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Schema validation (satellite: clean rejects, never a comparator TypeError)
+# ---------------------------------------------------------------------------
+
+
+def test_priority_tenant_tier_validated_at_admission(tiny_pipe):
+    base = dict(request_id="r", prompt="a cat", steps=4)
+    for bad, match in [
+        (dict(base, priority="high"), "priority must be an int"),
+        (dict(base, priority=True), "priority must be an int"),
+        (dict(base, priority=10**7), "priority must be within"),
+        (dict(base, tenant=""), "tenant"),
+        (dict(base, tenant=17), "tenant"),
+        (dict(base, tenant="x" * 200), "tenant"),
+        (dict(base, tier="gold"), "unknown tier"),
+    ]:
+        with pytest.raises(ValueError, match=match):
+            prepare(Request.from_dict(bad), tiny_pipe)
+    # The happy path round-trips, and absent fields stay absent in the
+    # JSONL form (tier-less traffic is byte-identical on the wire).
+    req = Request.from_dict(dict(base, tenant="acme", tier="premium"))
+    assert Request.from_dict(req.to_dict()) == req
+    bare = Request.from_dict(base)
+    assert "tenant" not in bare.to_dict() and "tier" not in bare.to_dict()
+
+
+def test_tier_never_joins_a_compile_key(tiny_pipe):
+    """Tiers must not fragment compiled programs: tenant/tier (and
+    priority) are scheduling metadata, invisible to every program key."""
+    def prep(**kw):
+        d = dict(request_id="r", prompt="a cat", target="a dog", steps=4,
+                 gate=2)
+        d.update(kw)
+        return prepare(Request.from_dict(d), tiny_pipe)
+
+    base = prep()
+    tiered = prep(tenant="acme", tier="premium", priority=5)
+    assert tiered.compile_key == base.compile_key
+    assert tiered.batch_key == base.batch_key
+    assert tiered.phase1_key == base.phase1_key
+    assert tiered.phase2_key == base.phase2_key
+    assert tiered.phase2_batch_key == base.phase2_batch_key
+
+
+# ---------------------------------------------------------------------------
+# Queue: quotas, precedence, weighted-fair ordering
+# ---------------------------------------------------------------------------
+
+
+def _prep_stub(rid, tenant=None, tier=None, priority=0, key=("k",)):
+    req = SimpleNamespace(request_id=rid, priority=priority, arrival_ms=0.0,
+                          deadline_ms=None, guidance=7.5, tenant=tenant,
+                          tier=tier)
+    return SimpleNamespace(request=req, batch_key=key, compile_key=key,
+                           controller=None, gate_step=1)
+
+
+def test_quota_rejection_kind_and_precedence_over_backpressure():
+    """A tenant at quota rejects with kind='quota' — and when the global
+    capacity is ALSO blown, the quota verdict wins (it is the actionable
+    one: backing off that tenant helps, 'retry later' does not)."""
+    slo = SloConfig(tenant_quota=2)
+    q = AdmissionQueue(capacity=3, slo=slo)
+    q.submit(_prep_stub("a1", tenant="acme"), 0.0)
+    q.submit(_prep_stub("a2", tenant="acme"), 0.0)
+    with pytest.raises(Rejected) as exc:
+        q.submit(_prep_stub("a3", tenant="acme"), 0.0)
+    assert exc.value.kind == "quota" and "acme" in exc.value.reason
+    # Other tenants (and tenant-less traffic) are unaffected by acme's
+    # quota — only the global bound applies to them.
+    q.submit(_prep_stub("b1", tenant="globex"), 0.0)
+    with pytest.raises(Rejected) as exc:
+        q.submit(_prep_stub("b2", tenant="globex"), 0.0)
+    assert exc.value.kind == "queue_full"
+    # Precedence: with acme at quota AND the queue full, quota wins.
+    with pytest.raises(Rejected) as exc:
+        q.submit(_prep_stub("a4", tenant="acme"), 0.0)
+    assert exc.value.kind == "quota"
+    # Releasing an acme request frees its quota slot.
+    q.release("a1")
+    q.submit(_prep_stub("a5", tenant="acme"), 1.0)
+
+
+def test_weighted_fair_drain_tier_first_then_tenant_interleave():
+    """Drain order: tier rank strictly first; within a tier the tenants'
+    fair-clock finish tags interleave a flooding tenant with a light one
+    instead of serving the flood FIFO."""
+    slo = SloConfig()
+    q = AdmissionQueue(capacity=32, slo=slo)
+    # Heavy tenant floods 4 best-effort requests, then a light tenant
+    # submits one; a premium request arrives last of all.
+    for i in range(4):
+        q.submit(_prep_stub(f"h{i}", tenant="heavy", tier="best_effort"),
+                 float(i))
+    q.submit(_prep_stub("light0", tenant="light", tier="best_effort"), 4.0)
+    q.submit(_prep_stub("prem0", tenant="late", tier="premium"), 5.0)
+    order = [e.request_id for e in q.drain()]
+    assert order[0] == "prem0"                    # tier rank first
+    assert order.index("light0") < order.index("h1"), \
+        "the light tenant's first request must interleave ahead of the " \
+        "heavy tenant's backlog (start-time fair queuing)"
+    # Priority still orders within a tier.
+    q.submit(_prep_stub("lo", tier="standard"), 6.0)
+    q.submit(_prep_stub("hi", tier="standard", priority=5), 7.0)
+    assert [e.request_id for e in q.drain()] == ["hi", "lo"]
+
+
+def test_fair_clock_weights():
+    fc = FairClock()
+    assert fc.tag("a", 1.0) == pytest.approx(1.0)
+    assert fc.tag("a", 1.0) == pytest.approx(2.0)
+    assert fc.tag("b", 4.0) == pytest.approx(0.25)   # heavier weight, slower clock
+    assert fc.tag(None, 1.0) == pytest.approx(1.0)   # anonymous lane
+
+
+# ---------------------------------------------------------------------------
+# Engine: fake runners, virtual time
+# ---------------------------------------------------------------------------
+
+
+class VirtualTimer:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt_s):
+        self.t += dt_s
+
+
+class FakeRunner:
+    def __init__(self, compile_key, bucket, timer, run_s=0.1, warm_s=0.5):
+        self.bucket = bucket
+        self.timer, self.run_s, self.warm_s = timer, run_s, warm_s
+
+    def warm(self, entries):
+        self.timer.advance(self.warm_s)
+
+    def __call__(self, entries, guidance):
+        self.timer.advance(self.run_s)
+        g = len(entries[0].request.prompts)
+        return np.zeros((self.bucket, g, 2, 2, 3), np.uint8)
+
+
+def _fake_serve(tiny_pipe, reqs, timer=None, **kw):
+    timer = timer or VirtualTimer()
+
+    def factory(compile_key, bucket):
+        return FakeRunner(compile_key, bucket, timer)
+
+    return list(serve_forever(tiny_pipe, reqs, runner_factory=factory,
+                              timer=timer, **kw))
+
+
+def _by_status(recs):
+    out = {}
+    for r in recs:
+        out.setdefault(r["status"], []).append(r)
+    return out
+
+
+def _req(rid, arrival=0.0, **kw):
+    return Request(request_id=rid, prompt="a cat", target="a dog",
+                   steps=4, arrival_ms=arrival, **kw)
+
+
+def test_tierless_traffic_with_slo_off_is_unchanged(tiny_pipe, tmp_path):
+    """Disabled-mode parity: with slo=None a tier-less trace produces no
+    slo summary block, no tier metric family, no preempted journal
+    records — and the record stream is byte-stable across reruns."""
+    reqs = [_req(f"r{i}", float(i)) for i in range(4)]
+    wal = str(tmp_path / "plain.wal")
+
+    def run(path):
+        j = Journal(path)
+        recs = _fake_serve(tiny_pipe, list(reqs), journal=j,
+                           max_batch=4, max_wait_ms=10.0)
+        j.close()
+        return recs
+
+    a = run(wal)
+    b = run(str(tmp_path / "plain2.wal"))
+    strip = lambda recs: json.dumps(
+        [{k: v for k, v in r.items() if k != "images"} for r in recs],
+        sort_keys=True)
+    assert strip(a) == strip(b)
+    assert "slo" not in a[-1]
+    kinds = {json.loads(l)["type"] for l in open(wal) if l.strip()}
+    assert "preempted" not in kinds
+    from p2p_tpu.obs import metrics as obs_metrics
+
+    snap = obs_metrics.registry().snapshot()
+    # The per-tier family appears only under an active SloConfig.
+    recs = _fake_serve(tiny_pipe, [_req("s0")], max_batch=4,
+                       max_wait_ms=10.0, slo=SloConfig())
+    assert "slo" in recs[-1]
+    snap2 = obs_metrics.registry().snapshot()
+    assert "serve_tier_requests_total" not in snap
+    assert "serve_tier_requests_total" in snap2
+
+
+def test_pressure_preemption_parks_spills_and_resumes(tiny_pipe, tmp_path):
+    """Mid-queue preemption: a best-effort request waiting in the phase-2
+    batcher is parked when premium pressure builds (carry spilled,
+    `preempted` WAL record, flight `preempt_wait` stage) and resumes when
+    the pressure clears — finishing with its phases detail naming the
+    scheduler's wait."""
+    from p2p_tpu.obs.flight import FlightTracer
+
+    wal = str(tmp_path / "preempt.wal")
+    journal = Journal(wal)
+    flight = FlightTracer()
+    timer = VirtualTimer()
+    slo = SloConfig(preempt_depth=2)
+    reqs = [_req("be0", 0.0, tier="best_effort", gate=0.5)] + \
+        [_req(f"p{i}", 150.0 + i, tier="premium") for i in range(8)]
+    recs = _fake_serve(tiny_pipe, reqs, timer=timer, journal=journal,
+                       flight=flight, max_batch=2, max_wait_ms=10.0,
+                       slo=slo)
+    journal.close()
+    by = _by_status(recs)
+    assert len(by["ok"]) == 9
+    summary = by["summary"][0]
+    assert summary["slo"]["preemptions"] >= 1
+    assert summary["slo"]["preempt_resumes"] >= 1
+    (be,) = [r for r in by["ok"] if r["request_id"] == "be0"]
+    assert be["phases"]["preempted"] is True
+    assert be["phases"]["preempt_wait_ms"] > 0
+    # The WAL holds the preempted record (same schema family as handoff).
+    wal_recs = [json.loads(l) for l in open(wal) if l.strip()]
+    pre = [r for r in wal_recs if r["type"] == "preempted"]
+    assert pre and pre[0]["id"] == "be0" and pre[0]["tier"] == "best_effort"
+    assert os.path.basename(pre[0]["carry_path"]).endswith(".npz")
+    # Flight: the parked span is its own attribution stage, and the
+    # timeline still sums exactly.
+    (fl,) = [r for r in flight.records if r["request_id"] == "be0"]
+    stages = [(s["stage"], s.get("pool")) for s in fl["segments"]]
+    assert ("preempt_wait", "phase2") in stages
+    assert fl["attribution_ok"] is True
+    events = [e["kind"] for e in fl["events"]]
+    assert "preempted" in events and "preempt_resumed" in events
+
+
+def test_preempted_request_cancelled_while_parked_gcs_spill(tiny_pipe,
+                                                            tmp_path):
+    """A parked request stays cancellable: the cancel resolves it in
+    place, the terminal WAL write discards its spill (no orphan), and a
+    replay finds nothing pending."""
+    wal = str(tmp_path / "cancel.wal")
+    journal = Journal(wal)
+    slo = SloConfig(preempt_depth=2)
+    reqs = [_req("be0", 0.0, tier="best_effort", gate=0.5)] + \
+        [_req(f"p{i}", 150.0 + i, tier="premium") for i in range(4)] + \
+        [{"cancel": "be0"}] + \
+        [_req(f"q{i}", 170.0 + i, tier="premium") for i in range(4)]
+    recs = _fake_serve(tiny_pipe, reqs, journal=journal, max_batch=2,
+                       max_wait_ms=10.0, slo=slo)
+    journal.close()
+    by = _by_status(recs)
+    assert [r["request_id"] for r in by["cancelled"]] == ["be0"]
+    wal_recs = [json.loads(l) for l in open(wal) if l.strip()]
+    assert any(r["type"] == "preempted" and r["id"] == "be0"
+               for r in wal_recs), "the drill never actually parked"
+    # The spill was discarded at the cancel terminal — no orphan .npz.
+    carry_dir = wal + ".carry"
+    leftovers = ([f for f in os.listdir(carry_dir)]
+                 if os.path.isdir(carry_dir) else [])
+    assert leftovers == []
+    from p2p_tpu.serve import replay
+
+    state = replay(wal)
+    assert state.pending == [] and state.orphans_swept == 0
+    assert state.terminal["be0"] == "cancelled"
+
+
+def test_chaos_preempt_then_kill_resumes_bitwise(tiny_pipe, tmp_path):
+    """The hand-off-boundary preemption drill end to end with REAL
+    runners: chaos preempt_then_kill parks the victim's carry at its
+    phase boundary and dies before resume; the restart folds the
+    `preempted` record like a crashed hand-off and serves the victim in
+    phase 2 off the spill — exactly-once, bitwise vs the never-preempted
+    run (asserted inside the drill)."""
+    drill = _chaos_drill()
+    res = drill.preempt_kill_drill(tiny_pipe, str(tmp_path / "pk.wal"),
+                                   steps=2)
+    assert res["killed"] is True
+    assert res["resumed_handoffs"] >= 1
+    assert res["bitwise_compared"] == res["n_requests"]
+    assert res["replay_skipped_corrupt"] == 0
+
+
+def test_slo_overload_policy_drill_small():
+    """A rehearsal-scale run of the quality gate's policy drill: shed
+    order and the premium p99 bound hold (the drill raises otherwise),
+    and the frozen sub-record keys come back."""
+    drill = _chaos_drill()
+    pipe = drill.tiny_pipeline()
+    res = drill.slo_overload_drill(pipe, n=96)
+    assert res["paid_shed"] == 0
+    assert res["best_effort_shed"] > 0
+    assert res["premium_p99_ratio"] <= 1.2
+    assert set(res) == {
+        "n_requests", "overload_factor", "premium_p99_ms",
+        "premium_uncontended_p99_ms", "premium_p99_ratio",
+        "best_effort_shed", "paid_shed", "preemptions",
+        "preempt_resumes", "quota_rejects"}
+
+
+# ---------------------------------------------------------------------------
+# Deadline jump: invariants with real runners
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_jump_serves_urgent_bitwise_and_guard_clean(tiny_pipe):
+    """Deadline-aware batching: an urgent bucket flushes onto the warm
+    program instead of aging out past its deadline — and the jump
+    changes WHEN the batch runs, never what it computes: images are
+    bitwise-identical to the unhurried run, every dispatch stays
+    transfer-guard clean, and the padded bucket is the same warm one
+    (the bucket bitwise contract)."""
+    import jax
+
+    from p2p_tpu.serve.programs import default_runner_factory
+
+    base = default_runner_factory(tiny_pipe)
+    guarded = []
+
+    class GuardedRunner:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def warm(self, entries):
+            self._inner.warm(entries)
+
+        def __call__(self, entries, guidance):
+            with jax.transfer_guard("disallow"):
+                out = self._inner(entries, guidance)
+            guarded.append(len(entries))
+            return out
+
+    def factory(compile_key, bucket):
+        return GuardedRunner(base(compile_key, bucket))
+
+    def req(i, deadline=None):
+        return Request(request_id=f"dj{i}", prompt="a cat riding a bike",
+                       target="a dog riding a bike", mode="replace",
+                       steps=2, seed=60 + i, arrival_ms=0.0,
+                       deadline_ms=deadline, tier="premium")
+
+    kw = dict(max_batch=4, max_wait_ms=500.0, prewarm=[req(9)],
+              runner_factory=factory, timer=lambda: 0.0)
+    # Unhurried baseline: no deadlines, buckets age out at 500ms.
+    calm = list(serve_forever(tiny_pipe, [req(0), req(1)], **kw))
+    calm_by = _by_status(calm)
+    assert len(calm_by["ok"]) == 2
+    # Urgent: 60ms deadlines would expire waiting out max_wait; with the
+    # jump they dispatch immediately onto the warm bucket and survive.
+    urgent = list(serve_forever(tiny_pipe,
+                                [req(0, deadline=60.0),
+                                 req(1, deadline=60.0)],
+                                slo=SloConfig(), **kw))
+    by = _by_status(urgent)
+    assert len(by["ok"]) == 2, [r for r in urgent if r["status"] != "ok"]
+    assert by["summary"][0]["slo"]["deadline_jumps"] >= 1
+    calm_ok = {r["request_id"]: r for r in calm_by["ok"]}
+    for r in by["ok"]:
+        # Same warm padded bucket (the bucket bitwise contract) and
+        # bitwise-identical outputs.
+        assert r["batch_lanes"] == calm_ok[r["request_id"]]["batch_lanes"]
+        np.testing.assert_array_equal(r["images"],
+                                      calm_ok[r["request_id"]]["images"])
+    assert len(guarded) >= 2
+    # Without the jump the same deadlines expire before the age-out.
+    nojump = list(serve_forever(tiny_pipe,
+                                [req(0, deadline=60.0),
+                                 req(1, deadline=60.0)],
+                                slo=SloConfig(deadline_jump=False), **kw))
+    assert len(_by_status(nojump).get("expired", [])) == 2
+
+
+# ---------------------------------------------------------------------------
+# dp=2 mesh leg
+# ---------------------------------------------------------------------------
+
+
+def test_slo_on_dp2_mesh(tiny_pipe):
+    """The scheduler is mesh-agnostic: quotas, tier ordering and the slo
+    summary block ride a dp=2 mesh unchanged, and the record stream is
+    byte-deterministic across reruns."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device CPU platform")
+    prompts = ("a cat riding a bike", "a dog riding a bike")
+
+    def req(rid, arrival, tier, tenant, gate=None, seed=0):
+        return Request(request_id=rid, prompt=prompts[0],
+                       target=prompts[1], mode="replace", steps=3,
+                       seed=seed, arrival_ms=arrival, tier=tier,
+                       tenant=tenant, gate=gate)
+
+    reqs = [req("m0", 0.0, "premium", "acme", gate=0.5, seed=42),
+            req("m1", 1.0, "best_effort", "acme", seed=7),
+            req("m2", 2.0, "best_effort", "acme", seed=8),
+            req("m3", 3.0, "standard", "globex", seed=9)]
+    slo = SloConfig(tenant_quota=2)
+
+    def run():
+        recs = list(serve_forever(tiny_pipe, list(reqs), max_batch=2,
+                                  max_wait_ms=5.0, timer=lambda: 0.0,
+                                  mesh="dp=2", slo=slo))
+        stripped = [{k: v for k, v in r.items()
+                     if k not in ("images", "mesh")} for r in recs]
+        return recs, json.dumps(stripped, sort_keys=True)
+
+    recs, blob = run()
+    by = _by_status(recs)
+    # acme's third outstanding request (m2) hits the quota.
+    assert sorted(r["request_id"] for r in by["ok"]) == ["m0", "m1", "m3"]
+    (rej,) = by["rejected"]
+    assert rej["request_id"] == "m2" and "quota" in rej["reason"]
+    summary = by["summary"][0]
+    assert summary["slo"]["quota_rejects"] == 1
+    assert summary["slo"]["tiers"]["premium"]["ok"] == 1
+    assert summary["mesh"]["dp"] == 2
+    _, blob2 = run()
+    assert blob == blob2

@@ -514,6 +514,264 @@ def rolling_restart_drill(pipe, trace, journal_path, *, cycles=3,
             "full_history_records": full_history_records}
 
 
+class _VirtualTimer:
+    """Injected wall clock for the deterministic SLO policy drill."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt_s):
+        self.t += dt_s
+
+
+def _p99(vals):
+    """Nearest-rank p99 (0 when empty) — matches the engine's summary
+    percentile arithmetic."""
+    if not vals:
+        return 0.0
+    v = sorted(vals)
+    idx = min(len(v) - 1, max(0, int(round(0.99 * (len(v) - 1)))))
+    return v[idx]
+
+
+def slo_overload_drill(pipe, *, n=192, seed=11, steps=4, overload=2.0,
+                       service_ms=80.0, max_batch=4) -> dict:
+    """The SLO policy drill (ISSUE 12): a seeded tenant/tier/gate-mixed
+    loadgen trace offered at ``overload``× the engine's service capacity,
+    served through the full scheduler (weighted-fair admission, tenant
+    quotas, tier-pure batches, phase-boundary preemption, per-tier
+    degradation) on a *deterministic virtual clock* — every dispatched
+    batch costs exactly ``service_ms`` of injected wall time, so the
+    whole overload scenario replays byte-identically and the policy
+    verdicts below are facts, not flakes.
+
+    Invariants raised as :class:`DrillFailure`:
+
+    1. **Shed order** — every ``shed`` record is a best-effort request:
+       the degradation ladder never sheds a paid tier while best-effort
+       traffic exists to absorb it.
+    2. **Premium p99 bound** — premium p99 under the 2× overload stays
+       within 1.2× of the *uncontended* premium p99 (the same premium
+       requests at the same arrival stamps with no competing traffic).
+    3. **Exactly-once** — every admitted request resolves to exactly one
+       terminal record, preemptions and sheds included.
+
+    Returns the ``serve.slo`` bench sub-record (frozen keys pinned in
+    tests/test_bench_rehearsal.py)."""
+    import importlib.util
+
+    from p2p_tpu.serve import DegradeConfig, SloConfig, serve_forever
+
+    spec = importlib.util.spec_from_file_location(
+        "p2p_loadgen", os.path.join(_REPO, "tools", "loadgen.py"))
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+
+    # Offered load = overload × capacity: the engine serves max_batch
+    # lanes per service_ms quantum, loadgen offers rate requests/s.
+    rate = overload * max_batch * 1000.0 / service_ms
+    trace = loadgen.generate_trace(
+        n, mode="poisson", rate_per_s=rate, seed=seed, steps=steps,
+        gate_mix=loadgen.parse_gate_mix("0.5:1,off:1"),
+        tenant_mix=loadgen.parse_name_mix("acme:2,globex:1,initech:1"),
+        tier_mix=loadgen.parse_name_mix("premium:1,best_effort:3"))
+    tier_of = {r["request_id"]: r.get("tier", "standard") for r in trace}
+
+    # Tuned so the drill actually exercises every mechanism: the quota
+    # binds (three tenants × 10 < the 2× backlog), preemption parks
+    # between-phases best-effort work, the ladder reaches the shed rung
+    # within a couple of service quanta, and min_bucket=4 keeps the
+    # level-2 shrink a no-op — a shrunken cap would force in-band
+    # compiles below the prewarmed bucket, charging premium latency for
+    # a *compile*, which is the one cost compile-ahead exists to avoid.
+    slo = SloConfig(tenant_quota=10, preempt_depth=8)
+    degrade = DegradeConfig(depth_threshold=8, window_ms=service_ms,
+                            min_bucket=4)
+
+    def run(reqs):
+        from p2p_tpu.serve import Request
+
+        timer = _VirtualTimer()
+
+        class Runner:
+            def __init__(self, compile_key, bucket):
+                self.bucket = bucket
+
+            def warm(self, entries):
+                timer.advance(2 * service_ms / 1000.0)
+
+            def __call__(self, entries, guidance):
+                import numpy as np
+
+                timer.advance(service_ms / 1000.0)
+                g = len(entries[0].request.prompts)
+                return np.zeros((self.bucket, g, 2, 2, 3), np.uint8)
+
+        objs = [Request.from_dict(d) for d in reqs]
+        return list(serve_forever(
+            pipe, objs, runner_factory=Runner, timer=timer,
+            max_batch=max_batch, phase2_max_batch=max_batch,
+            max_wait_ms=service_ms, queue_cap=4 * n,
+            prewarm=_prewarm_reps(pipe, reqs), slo=slo, degrade=degrade))
+
+    recs = run(trace)
+    check_exactly_once(trace, recs, "slo overload run")
+    summary = recs[-1]
+
+    def _lat(records, tier):
+        return [r["total_ms"] for r in records
+                if r.get("status") == "ok"
+                and tier_of.get(r.get("request_id")) == tier]
+
+    shed_tiers = [tier_of[r["request_id"]] for r in recs
+                  if r.get("status") == "shed"]
+    paid_shed = sum(1 for t in shed_tiers if t != "best_effort")
+    if paid_shed:
+        raise DrillFailure(
+            f"slo overload: {paid_shed} paid-tier request(s) shed while "
+            f"best-effort traffic existed — the ladder must shed "
+            f"best-effort first (shed tiers: {sorted(set(shed_tiers))})")
+
+    # Uncontended baseline: the SAME premium requests at the SAME arrival
+    # stamps, with no competing traffic (arrival order is preserved, so
+    # the trace stays sorted).
+    premium = [r for r in trace if r.get("tier") == "premium"]
+    unc = run(premium)
+    check_exactly_once(premium, unc, "uncontended premium run")
+    p99_over = _p99(_lat(recs, "premium"))
+    p99_unc = _p99(_lat(unc, "premium"))
+    ratio = p99_over / p99_unc if p99_unc > 0 else 0.0
+    if p99_unc <= 0:
+        raise DrillFailure("slo overload: uncontended premium p99 is 0 — "
+                           "the baseline run served nothing measurable")
+    if ratio > 1.2:
+        raise DrillFailure(
+            f"slo overload: premium p99 {p99_over:.1f}ms is {ratio:.2f}x "
+            f"its uncontended p99 {p99_unc:.1f}ms (> 1.2x) — the "
+            f"scheduler failed to protect the paid tier")
+    slo_block = summary.get("slo", {})
+    return {
+        "n_requests": n,
+        "overload_factor": overload,
+        "premium_p99_ms": round(p99_over, 2),
+        "premium_uncontended_p99_ms": round(p99_unc, 2),
+        "premium_p99_ratio": round(ratio, 4),
+        "best_effort_shed": len(shed_tiers) - paid_shed,
+        "paid_shed": paid_shed,
+        "preemptions": slo_block.get("preemptions", 0),
+        "preempt_resumes": slo_block.get("preempt_resumes", 0),
+        "quota_rejects": slo_block.get("quota_rejects", 0),
+    }
+
+
+def preempt_kill_drill(pipe, journal_path, *, steps=3,
+                       serve_kw=None) -> dict:
+    """The preemption durability drill (ISSUE 12): a chaos
+    ``preempt_then_kill`` forces a gated request's preemption at its
+    phase boundary (carry spilled, ``preempted`` WAL record), then the
+    process dies before the parked work resumes. The restart must fold
+    the preempted record exactly like a crashed hand-off: the victim
+    resumes in phase 2 off the spill, every request reaches exactly one
+    terminal across the union of both runs, and every ``ok`` output is
+    bitwise-identical to the never-preempted run."""
+    from p2p_tpu.serve import (FaultPlan, Journal, Request, SimulatedKill,
+                               serve_forever)
+    from p2p_tpu.serve.chaos import PREEMPT_THEN_KILL
+
+    prompts = ("a cat riding a bike", "a dog riding a bike")
+
+    def req(rid, arrival, gate=None, seed=0):
+        return {"request_id": rid, "prompt": prompts[0],
+                "target": prompts[1], "mode": "replace", "steps": steps,
+                "seed": seed, "arrival_ms": arrival,
+                **({"gate": gate} if gate is not None else {})}
+
+    victim = "pk-victim"
+    trace = [req(victim, 0.0, gate=0.5, seed=42),
+             req("pk-g1", 1.0, gate=0.5, seed=43),
+             req("pk-u0", 2.0, seed=7),
+             req("pk-g2", 500.0, gate=0.5, seed=44)]
+    kw = dict(max_batch=4, max_wait_ms=20.0, queue_cap=64,
+              phase2_max_batch=4)
+    kw.update(serve_kw or {})
+    if "prewarm" not in kw:
+        kw["prewarm"] = _prewarm_reps(pipe, trace)
+
+    def to_reqs():
+        return [Request.from_dict(d) for d in trace]
+
+    clean = list(serve_forever(pipe, to_reqs(), **kw))
+    clean_by_id = check_exactly_once(trace, clean, "never-preempted run")
+
+    if os.path.exists(journal_path):
+        os.remove(journal_path)
+    plan = FaultPlan(by_request={victim: PREEMPT_THEN_KILL})
+    journal = Journal(journal_path)
+    first: list = []
+    killed = False
+    gen = serve_forever(pipe, to_reqs(), journal=journal, chaos=plan, **kw)
+    try:
+        for rec in first_iter(gen, first):
+            pass
+    except SimulatedKill:
+        killed = True
+        journal._f.close()   # simulated death: no clean close
+    if not killed:
+        raise DrillFailure("preempt_then_kill never fired — the victim's "
+                           "phase boundary was never reached")
+
+    journal2 = Journal(journal_path)
+    if victim not in journal2.replay_state.handoffs:
+        raise DrillFailure("the preempted record did not fold into the "
+                           "replay hand-off map — the victim would re-run "
+                           "phase 1 instead of resuming off its spill")
+    second = list(serve_forever(pipe, to_reqs(), journal=journal2, **kw))
+    journal2.close()
+
+    seen: dict = {}
+    run2 = {r["request_id"]: r for r in _terminal_records(second)}
+    for rec in _terminal_records(first):
+        rid = rec["request_id"]
+        if rid in run2 and "rejected" not in (rec["status"],
+                                              run2[rid]["status"]):
+            raise DrillFailure(
+                f"preempt_then_kill: request {rid!r} reached a terminal "
+                f"state in both runs ({rec['status']!r}, then "
+                f"{run2[rid]['status']!r})")
+        seen.setdefault(rid, rec)
+    for rid, rec in run2.items():
+        seen.setdefault(rid, rec)
+    ids = [r["request_id"] for r in trace]
+    missing = [rid for rid in ids if rid not in seen]
+    if missing:
+        raise DrillFailure(f"preempt_then_kill: {len(missing)} request(s) "
+                           f"lost across the kill: {missing}")
+    bitwise = check_bitwise_vs_clean(clean_by_id, seen)
+    summary2 = second[-1]
+    resumed = summary2.get("phases", {}).get("resumed_handoffs", 0)
+    if resumed < 1:
+        raise DrillFailure("the restart served the victim without "
+                           "resuming off the preemption spill")
+    return {
+        "n_requests": len(ids),
+        "killed": killed,
+        "bitwise_compared": bitwise,
+        "resumed_handoffs": resumed,
+        "replay_skipped_corrupt": journal2.replay_state.skipped_corrupt,
+    }
+
+
+def first_iter(gen, sink):
+    """Iterate ``gen`` appending into ``sink`` — keeps the try/except at
+    the call site tight while the kill can fire mid-iteration."""
+    for rec in gen:
+        sink.append(rec)
+        yield rec
+
+
 def main(argv=None) -> int:
     _pin_cpu()
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -551,6 +809,18 @@ def main(argv=None) -> int:
                     help="with --rolling: arm a chaos kill_during_drain in "
                          "the middle cycle (that drain dies half-way; the "
                          "restart must still be exactly-once)")
+    ap.add_argument("--slo-overload", action="store_true",
+                    help="also run the SLO policy drill (ISSUE 12): a "
+                         "tenant/tier-mixed trace at 2x overload on a "
+                         "deterministic virtual clock must shed best-"
+                         "effort only and hold premium p99 within 1.2x "
+                         "of its uncontended p99")
+    ap.add_argument("--preempt-kill", action="store_true",
+                    help="also run the preemption durability drill "
+                         "(ISSUE 12): chaos preempt_then_kill parks a "
+                         "gated request's carry then dies; the restart "
+                         "must resume it off the spill exactly-once with "
+                         "bitwise-identical output")
     ap.add_argument("--warmup", action="store_true",
                     help="one unmeasured clean pass first, so the p95 "
                          "delta is retry cost, not compile noise")
@@ -585,6 +855,12 @@ def main(argv=None) -> int:
             result["rolling_restart"] = rolling_restart_drill(
                 pipe, [r for r in trace if "cancel" not in r], jpath,
                 cycles=args.rolling, kill_mid_drain=args.kill_mid_drain)
+        if args.slo_overload:
+            result["slo"] = slo_overload_drill(pipe)
+        if args.preempt_kill:
+            jpath = args.journal or os.path.join(
+                tempfile.mkdtemp(prefix="p2p-preempt-"), "preempt.wal")
+            result["preempt_kill"] = preempt_kill_drill(pipe, jpath)
     except DrillFailure as e:
         print(f"DRILL FAILED: {e}", file=sys.stderr)
         return 1

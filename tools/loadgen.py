@@ -21,7 +21,10 @@ each request's phase-gate spec from a weighted distribution (e.g.
 ``0.5:2,off:1``) with the same seeded RNG, so a trace actually exercises
 the serve layer's phase hand-off and mixed-phase packing; the default
 (no mix, no ``--gate``) keeps every request ungated — byte-identical to
-pre-gate-mix traces.
+pre-gate-mix traces. ``--tenant-mix``/``--tier-mix`` (ISSUE 12) draw the
+SLO scheduling fields (``tenant``, ``tier``) per request the same way —
+each mix on its OWN derived RNG stream, so adding or dropping any mix
+leaves arrivals, seeds and the other mixes byte-identical.
 
     python tools/loadgen.py --n 48 --mode poisson --rate 20 --seed 0 \
         --steps 4 --out demo.jsonl
@@ -70,10 +73,10 @@ _CORPUS = (
 )
 
 
-def parse_gate_mix(spec: str) -> List[tuple]:
-    """``"0.5:2,off:1,auto:1"`` → ``[(0.5, 2.0), (None, 1.0), ('auto',
-    1.0)]`` — weighted gate specs, ``off``/``none`` meaning ungated, a
-    bare entry meaning weight 1. Weights must be positive."""
+def _parse_mix(spec: str, what: str, convert) -> List[tuple]:
+    """Shared ``value:weight,...`` mix parser: ``off``/``none`` meaning
+    the field is absent, a bare entry meaning weight 1, weights positive.
+    ``convert`` maps the raw value string to its typed form."""
     out: List[tuple] = []
     for part in spec.split(","):
         part = part.strip()
@@ -85,18 +88,33 @@ def parse_gate_mix(spec: str) -> List[tuple]:
         else:
             val, weight = part, 1.0
         if weight <= 0:
-            raise ValueError(f"gate-mix weight must be positive in {part!r}")
+            raise ValueError(f"{what} weight must be positive in {part!r}")
         val = val.strip()
-        if val in ("off", "none"):
-            gate = None
-        elif val == "auto":
-            gate = "auto"
-        else:
-            gate = float(val) if "." in val else int(val)
-        out.append((gate, weight))
+        out.append((None if val in ("off", "none") else convert(val),
+                    weight))
     if not out:
-        raise ValueError(f"empty gate mix {spec!r}")
+        raise ValueError(f"empty {what} {spec!r}")
     return out
+
+
+def parse_gate_mix(spec: str) -> List[tuple]:
+    """``"0.5:2,off:1,auto:1"`` → ``[(0.5, 2.0), (None, 1.0), ('auto',
+    1.0)]`` — weighted gate specs, ``off``/``none`` meaning ungated, a
+    bare entry meaning weight 1. Weights must be positive."""
+    def convert(val):
+        if val == "auto":
+            return "auto"
+        return float(val) if "." in val else int(val)
+
+    return _parse_mix(spec, "gate mix", convert)
+
+
+def parse_name_mix(spec: str, what: str = "mix") -> List[tuple]:
+    """``"premium:1,best_effort:3"`` / ``"acme:2,globex:1,off:1"`` →
+    weighted *string* values for the ``--tier-mix``/``--tenant-mix``
+    per-request draws (``off``/``none`` = the request carries no such
+    field). Same syntax and weight rules as :func:`parse_gate_mix`."""
+    return _parse_mix(spec, what, str)
 
 
 def generate_stream(
@@ -114,6 +132,8 @@ def generate_stream(
     distinct_keys: int = 1,
     gate=None,
     gate_mix: Optional[List[tuple]] = None,
+    tenant_mix: Optional[List[tuple]] = None,
+    tier_mix: Optional[List[tuple]] = None,
 ):
     """Yield request dicts in arrival order until ``arrival_ms`` would
     exceed ``duration_ms`` (and/or ``n`` requests have been produced; both
@@ -122,10 +142,15 @@ def generate_stream(
 
     **Seed-stable prefix contract** (pinned in tests/test_loadgen.py): the
     RNG draws per request, in request order — one interarrival gap, one
-    seed, then (with a mix) one gate draw on the separate derived stream —
-    so any prefix of a stream is independent of the horizon: the first K
-    requests are byte-identical for every ``duration_ms``/``n`` ≥ K, and
-    :func:`generate_trace` is literally ``list(generate_stream(n=K))``."""
+    seed, then (with a mix) one gate/tenant/tier draw, each on its own
+    separate derived stream — so any prefix of a stream is independent of
+    the horizon: the first K requests are byte-identical for every
+    ``duration_ms``/``n`` ≥ K, and :func:`generate_trace` is literally
+    ``list(generate_stream(n=K))``. Every mix rides its *own* derived RNG
+    stream, so adding (or dropping) one mix never perturbs arrivals,
+    seeds, or another mix's draws — a tenant/tier-mixed trace is
+    byte-identical to the mix-less trace everywhere but its own fields
+    (the ``--gate-mix`` discipline)."""
     import numpy as np
 
     if mode not in ("poisson", "burst"):
@@ -134,13 +159,26 @@ def generate_stream(
         raise ValueError(f"rate_per_s must be positive, got {rate_per_s}")
     if duration_ms is not None and duration_ms < 0:
         raise ValueError(f"duration_ms must be >= 0, got {duration_ms}")
-    if gate_mix is not None:
-        total_w = sum(w for _, w in gate_mix)
-        cuts = np.cumsum([w / total_w for _, w in gate_mix])
-        # A separate derived stream (the with_cancels idiom): gate draws
-        # must not perturb the arrival/seed stream, so a mixed trace stays
-        # byte-identical to the no-mix trace everywhere but 'gate'.
-        gate_rng = np.random.RandomState(seed ^ 0x6A7E)
+
+    def _mix_drawer(mix, salt):
+        # A separate derived stream per mix (the with_cancels idiom):
+        # draws must not perturb the arrival/seed stream or each other.
+        total_w = sum(w for _, w in mix)
+        cuts = np.cumsum([w / total_w for _, w in mix])
+        mix_rng = np.random.RandomState(seed ^ salt)
+
+        def draw():
+            x = mix_rng.random_sample()
+            return mix[int(np.searchsorted(cuts, x, side="right"))
+                       if x < cuts[-1] else len(mix) - 1][0]
+        return draw
+
+    draw_gate = (_mix_drawer(gate_mix, 0x6A7E)
+                 if gate_mix is not None else None)
+    draw_tenant = (_mix_drawer(tenant_mix, 0x7E2A47)
+                   if tenant_mix is not None else None)
+    draw_tier = (_mix_drawer(tier_mix, 0x3C11E7)
+                 if tier_mix is not None else None)
     rng = np.random.RandomState(seed)
     at = 0.0
     i = 0
@@ -169,14 +207,17 @@ def generate_stream(
             "seed": int(rng.randint(0, 2 ** 31 - 1)),
             "arrival_ms": round(float(at), 3),
         }
-        req_gate = gate
-        if gate_mix is not None:
-            draw = gate_rng.random_sample()
-            req_gate = gate_mix[int(np.searchsorted(cuts, draw,
-                                                    side="right"))
-                                if draw < cuts[-1] else len(gate_mix) - 1][0]
+        req_gate = draw_gate() if draw_gate is not None else gate
         if req_gate is not None:
             req["gate"] = req_gate
+        if draw_tenant is not None:
+            tenant = draw_tenant()
+            if tenant is not None:
+                req["tenant"] = tenant
+        if draw_tier is not None:
+            tier = draw_tier()
+            if tier is not None:
+                req["tier"] = tier
         if deadline_ms is not None:
             req["deadline_ms"] = deadline_ms
         yield req
@@ -196,6 +237,8 @@ def generate_trace(
     distinct_keys: int = 1,
     gate=None,
     gate_mix: Optional[List[tuple]] = None,
+    tenant_mix: Optional[List[tuple]] = None,
+    tier_mix: Optional[List[tuple]] = None,
 ) -> List[dict]:
     """Build ``n`` request dicts sorted by ``arrival_ms`` (deterministic in
     ``seed``) — the finite materialized form of :func:`generate_stream`,
@@ -203,14 +246,17 @@ def generate_trace(
     contract). ``gate_mix`` (:func:`parse_gate_mix` pairs) draws each
     request's gate from the weighted distribution — it overrides ``gate``,
     and the draws ride a separate seed-derived RNG stream, so arrivals and
-    seeds stay byte-identical to the no-mix trace."""
+    seeds stay byte-identical to the no-mix trace. ``tenant_mix`` /
+    ``tier_mix`` (:func:`parse_name_mix` pairs) draw the SLO scheduling
+    fields the same way, each on its own derived stream."""
     if n < 1:
         raise ValueError(f"n must be >= 1, got {n}")
     return list(generate_stream(
         None, n=n, mode=mode, rate_per_s=rate_per_s, seed=seed, steps=steps,
         scheduler=scheduler, burst_size=burst_size,
         burst_gap_ms=burst_gap_ms, deadline_ms=deadline_ms,
-        distinct_keys=distinct_keys, gate=gate, gate_mix=gate_mix))
+        distinct_keys=distinct_keys, gate=gate, gate_mix=gate_mix,
+        tenant_mix=tenant_mix, tier_mix=tier_mix))
 
 
 def stream_with_cancels(stream, seed: int, rate: float):
@@ -293,6 +339,19 @@ def main(argv=None) -> int:
                          "value = weight 1). Overrides --gate; exercises "
                          "the serve layer's phase hand-off and "
                          "mixed-phase packing")
+    ap.add_argument("--tenant-mix", default=None, metavar="SPEC",
+                    help="weighted tenant distribution drawn per request "
+                         "on its own derived RNG stream, e.g. "
+                         "'acme:2,globex:1,off:1' ('off'/'none' = no "
+                         "tenant field; bare value = weight 1) — "
+                         "arrivals/seeds stay byte-identical to the "
+                         "mix-less trace (the --gate-mix discipline)")
+    ap.add_argument("--tier-mix", default=None, metavar="SPEC",
+                    help="weighted SLO-tier distribution drawn per "
+                         "request on its own derived RNG stream, e.g. "
+                         "'premium:1,best_effort:3' (tiers: premium, "
+                         "standard, best_effort; 'off'/'none' = no tier "
+                         "field)")
     ap.add_argument("--cancel-rate", type=float, default=0.0,
                     help="interleave seeded {'cancel': id} markers at this "
                          "per-request probability (each victim cancelled "
@@ -316,6 +375,10 @@ def main(argv=None) -> int:
     if isinstance(gate, str) and gate != "auto":
         gate = float(gate) if "." in gate else int(gate)
     gate_mix = parse_gate_mix(args.gate_mix) if args.gate_mix else None
+    tenant_mix = (parse_name_mix(args.tenant_mix, "tenant mix")
+                  if args.tenant_mix else None)
+    tier_mix = (parse_name_mix(args.tier_mix, "tier mix")
+                if args.tier_mix else None)
     if args.duration_ms is not None:
         if args.fault_rate > 0:
             ap.error("--fault-rate needs a finite --n trace (the fault "
@@ -325,7 +388,8 @@ def main(argv=None) -> int:
             seed=args.seed, steps=args.steps, scheduler=args.scheduler,
             burst_size=args.burst_size, burst_gap_ms=args.burst_gap_ms,
             deadline_ms=args.deadline_ms, distinct_keys=args.distinct_keys,
-            gate=gate, gate_mix=gate_mix)
+            gate=gate, gate_mix=gate_mix, tenant_mix=tenant_mix,
+            tier_mix=tier_mix)
         if args.cancel_rate > 0:
             stream = stream_with_cancels(stream, args.seed,
                                          args.cancel_rate)
@@ -342,7 +406,8 @@ def main(argv=None) -> int:
         steps=args.steps, scheduler=args.scheduler,
         burst_size=args.burst_size, burst_gap_ms=args.burst_gap_ms,
         deadline_ms=args.deadline_ms, distinct_keys=args.distinct_keys,
-        gate=gate, gate_mix=gate_mix)
+        gate=gate, gate_mix=gate_mix, tenant_mix=tenant_mix,
+        tier_mix=tier_mix)
     if args.fault_rate > 0:
         plan_path = args.fault_plan_out or (
             args.out and args.out + ".faults.json")

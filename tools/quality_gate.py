@@ -329,6 +329,35 @@ def _lifecycle():
         serve_kw={"timer": lambda: 0.0})
 
 
+def _slo():
+    """The SLO-tiered scheduling contract (ISSUE 12), two halves:
+
+    1. **Policy** — the deterministic virtual-clock overload drill
+       (``chaos_drill.slo_overload_drill``): a seeded tenant/tier-mixed
+       trace at 2× the engine's service capacity must shed best-effort
+       ONLY, hold premium p99 within 1.2× of its uncontended p99, and
+       resolve every request exactly once (quota rejections, preemptions
+       and sheds included). The drill raises on any violation.
+    2. **Durability** — ``chaos_drill.preempt_kill_drill``: a chaos
+       ``preempt_then_kill`` parks a gated request's carry (journaled
+       ``preempted`` record) and dies before the resume; the restart
+       must resume it off the spill exactly-once with bitwise-identical
+       output (real runners, real spills)."""
+    import importlib.util
+    import tempfile
+
+    spec = importlib.util.spec_from_file_location(
+        "p2p_chaos_drill", os.path.join(_REPO, "tools", "chaos_drill.py"))
+    drill = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(drill)
+
+    pipe = drill.tiny_pipeline()
+    policy = drill.slo_overload_drill(pipe)
+    jpath = os.path.join(tempfile.mkdtemp(prefix="p2p-slo-"), "preempt.wal")
+    durability = drill.preempt_kill_drill(pipe, jpath)
+    return policy, durability
+
+
 def _soak():
     """The opt-in long-horizon soak rehearsal (ISSUE 9 acceptance): ≥500
     virtual-clock-served requests across ≥5 snapshot/compact/restart
@@ -488,6 +517,11 @@ def main(argv=None) -> int:
                     help="skip the rolling-restart lifecycle check "
                          "(ISSUE 9; ~30s: 3 drain/restart cycles over a "
                          "gated trace, real runners)")
+    ap.add_argument("--skip-slo", action="store_true",
+                    help="skip the SLO-tiered scheduling check (ISSUE 12; "
+                         "~20s: the virtual-clock 2x-overload policy "
+                         "drill + the preempt_then_kill durability "
+                         "drill)")
     ap.add_argument("--soak", action="store_true",
                     help="also run the opt-in soak rehearsal (ISSUE 9): "
                          "≥500 requests across ≥5 snapshot/compact/"
@@ -517,13 +551,13 @@ def main(argv=None) -> int:
                                        "obs_overhead", "fault_drill",
                                        "static_analysis", "flight_parity",
                                        "bench_trend", "lifecycle", "soak",
-                                       "mesh_parity"}
+                                       "mesh_parity", "slo"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
                      f"valid: {', '.join(cases)}, phase_gate, serve_parity, "
                      f"obs_overhead, fault_drill, static_analysis, "
                      f"flight_parity, bench_trend, lifecycle, soak, "
-                     f"mesh_parity")
+                     f"mesh_parity, slo")
 
     drifted = []
     for name, fn in cases.items():
@@ -671,6 +705,33 @@ def main(argv=None) -> int:
                   f"full-history records {'ok' if ok else 'DRIFT'}")
             if not ok:
                 drifted.append("lifecycle")
+
+    if not args.skip_slo and (only is None or "slo" in only):
+        try:
+            policy, durability = _slo()
+        except AssertionError as e:  # DrillFailure: an invariant broke
+            print(f"{'slo':16s} INVARIANT VIOLATED: {e}")
+            drifted.append("slo")
+        else:
+            ok = (policy["premium_p99_ratio"] <= 1.2
+                  and policy["best_effort_shed"] > 0
+                  and policy["paid_shed"] == 0
+                  and policy["preemptions"] > 0
+                  and policy["quota_rejects"] > 0
+                  and durability["resumed_handoffs"] >= 1
+                  and durability["bitwise_compared"] > 0
+                  and durability["replay_skipped_corrupt"] == 0)
+            print(f"{'slo':16s} premium p99 "
+                  f"{policy['premium_p99_ratio']:.3f}x uncontended, "
+                  f"{policy['best_effort_shed']} best-effort shed / "
+                  f"{policy['paid_shed']} paid, "
+                  f"{policy['preemptions']} preemptions, "
+                  f"{policy['quota_rejects']} quota rejects; "
+                  f"preempt+kill {durability['resumed_handoffs']} resumed, "
+                  f"{durability['bitwise_compared']} bitwise "
+                  f"{'ok' if ok else 'DRIFT'}")
+            if not ok:
+                drifted.append("slo")
 
     if args.soak or (only is not None and "soak" in only):
         # Opt-in volume rehearsal — minutes of fake-runner traffic; the
