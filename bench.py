@@ -1044,6 +1044,20 @@ def _measure(preset):
             extras["serve"]["slo"] = _load_tool(
                 "chaos_drill").slo_overload_drill(pipe)
 
+            # Semantic caching (ISSUE 13): the seeded --zipf 1.1 cached-
+            # vs-uncached parity drill (tools/chaos_drill.py, the same
+            # scenario the quality gate's `cache_parity` leg enforces —
+            # every cached serve bitwise-identical to its uncached twin).
+            # The headline key is amplification: img/s served cached over
+            # uncached at the identical offered trace — equal device-
+            # seconds of demand, so unlike repacking wins this one is
+            # honestly measurable at CPU rehearsal (served-from-cache
+            # requests cost no compute on ANY backend). Watched by
+            # tools/benchwatch.py (serve.cache.amplification, higher is
+            # better) alongside the per-layer hit rates.
+            extras["serve"]["cache"] = _load_tool(
+                "chaos_drill").cache_parity_drill(pipe)
+
         # Telemetry-overhead block (ISSUE 3): the same headline single-group
         # edit run with the obs instrumentation enabled (phase-tagged step
         # callbacks traced in, host collector installed) vs disabled, so

@@ -350,3 +350,135 @@ def check_compile_key(pipe=None,
             program_changed=var_fp != base_fp,
             key_changed=var_key != base_key))
     return verdicts
+
+
+# ---------------------------------------------------------------------------
+# Content-key completeness (ISSUE 13) — the semantic-cache poisoning guard
+# ---------------------------------------------------------------------------
+
+#: Which Request fields determine the request's OUTPUT IMAGES — the
+#: checker's own declaration, independent of the hand partition in
+#: ``serve.request`` (CONTENT_FIELDS/SCHEDULING_FIELDS), so the two
+#: derivations cross-check each other. A field marked True must perturb
+#: ``content_key`` (missing ⇒ cache *poisoning*: a hit serves wrong
+#: images); a field marked False must not (superfluous ⇒ identical
+#: traffic split across cache lines: lost hits). The sweep also fails on
+#: any Request field absent from this map — a new schema field cannot
+#: dodge the cache-identity decision by omission.
+OUTPUT_DETERMINING: Dict[str, bool] = {
+    "prompt": True,
+    "target": True,
+    "mode": True,
+    "cross_steps": True,
+    "self_steps": True,
+    "blend_words": True,
+    "equalizer": True,
+    "blend_resolution": True,
+    "seed": True,
+    "steps": True,
+    "scheduler": True,
+    "guidance": True,
+    "negative_prompt": True,
+    "gate": True,
+    "request_id": False,
+    "arrival_ms": False,
+    "deadline_ms": False,
+    "priority": False,
+    "tenant": False,
+    "tier": False,
+}
+
+
+@dataclasses.dataclass
+class ContentVerdict:
+    field: str
+    output_determining: bool
+    key_changed: bool
+
+    @property
+    def ok(self) -> bool:
+        return self.output_determining == self.key_changed
+
+    @property
+    def problem(self) -> str:
+        if self.ok:
+            return ""
+        if self.output_determining:
+            return ("determines the output images but NOT content_key — "
+                    "cache poisoning: a request differing only in this "
+                    "field would be served another request's images")
+        return ("changes content_key but NOT the output — lost hits: "
+                "identical traffic split across cache lines by pure "
+                "scheduling metadata")
+
+    def format(self) -> str:
+        marks = (f"output={'Δ' if self.output_determining else '='} "
+                 f"key={'Δ' if self.key_changed else '='}")
+        return (f"{'ok  ' if self.ok else 'FAIL'} {self.field:18s} {marks}"
+                + (f"  {self.problem}" if not self.ok else ""))
+
+
+def check_content_key(pipe=None,
+                      key_fn: Optional[Callable] = None,
+                      fields: Optional[List[str]] = None
+                      ) -> List[ContentVerdict]:
+    """The completeness sweep over the semantic cache's ``content_key``
+    (ISSUE 13), same idiom as :func:`check_compile_key`: every Request
+    field is perturbed against the edit base (so controller-shaping
+    fields are live) and both directions must hold per field —
+    output-determining fields (:data:`OUTPUT_DETERMINING`) must perturb
+    the key, scheduling metadata must not.
+
+    The oracle is the declared map rather than a traced program: seed,
+    guidance and prompt change output *values* invisible to any jaxpr
+    structure, so there is nothing cheaper than real execution to trace —
+    the bitwise half is pinned empirically by the cache-parity drill
+    (every cached serve bitwise-identical to its uncached twin) and by
+    the value-only field test in tests/test_semcache.py. What this sweep
+    stops trusting is the hand *derivation*: the checker's own field map
+    is cross-checked against ``serve.request``'s CONTENT/SCHEDULING
+    partition, and a schema field missing from either raises.
+
+    ``key_fn(prepared) -> hashable`` overrides the key under test (the
+    masking hook: hiding ``seed`` from the key must be caught as
+    poisoning for exactly the ``seed`` field)."""
+    from ..serve.request import (CONTENT_FIELDS, Request, SCHEDULING_FIELDS,
+                                 prepare)
+
+    if pipe is None:
+        from .contracts import tiny_pipeline
+
+        pipe = tiny_pipeline()
+    key_fn = key_fn or (lambda prep: prep.content_key)
+
+    declared = {f.name for f in dataclasses.fields(Request)}
+    for name, covered in (("OUTPUT_DETERMINING map", set(OUTPUT_DETERMINING)),
+                          ("compile-key sweep VARIANTS", set(VARIANTS))):
+        missing = declared - covered
+        if missing:
+            raise ValueError(
+                f"Request field(s) {sorted(missing)} are missing from the "
+                f"{name}: extend analysis.compile_key so the content-key "
+                "completeness check covers the new schema")
+    # Cross-check the independent derivations: the checker's map vs the
+    # serve schema's CONTENT/SCHEDULING partition.
+    ours = {f for f, v in OUTPUT_DETERMINING.items() if v}
+    theirs = set(CONTENT_FIELDS)
+    if ours != theirs or (declared - ours) != set(SCHEDULING_FIELDS):
+        raise ValueError(
+            f"analysis.compile_key.OUTPUT_DETERMINING disagrees with "
+            f"serve.request's CONTENT_FIELDS/SCHEDULING_FIELDS partition "
+            f"on {sorted(ours ^ theirs)}: resolve which derivation is "
+            "wrong before caching can serve this schema")
+
+    todo = fields if fields is not None else sorted(OUTPUT_DETERMINING)
+    verdicts = []
+    for field in todo:
+        variant, extra = VARIANTS[field]
+        base_key = key_fn(prepare(_request(dict(extra)), pipe))
+        var_key = key_fn(prepare(_request({**extra, field: variant}), pipe))
+        verdicts.append(ContentVerdict(
+            field=field,
+            output_determining=OUTPUT_DETERMINING[field],
+            key_changed=var_key != base_key))
+    return verdicts

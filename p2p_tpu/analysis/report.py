@@ -98,11 +98,18 @@ def run_contract_pass(pipe=None, buckets=(1, 2, 4, 8),
     # base (verdicts land as <field>@phase1 / <field>@phase2): the
     # hand-off's cache-poisoning guard rides the same report gate.
     verdicts += ck_mod.check_phase_keys(pipe, fields=compile_key_fields)
+    # The semantic cache's content_key sweeps the same schema against the
+    # declared OUTPUT_DETERMINING map (ISSUE 13): a field that determines
+    # the output images but not the key is cache poisoning — wrong images
+    # served bitwise-confidently — so it rides the same report gate.
+    content = ck_mod.check_content_key(pipe, fields=compile_key_fields)
     return {
         "contracts": {"results": results,
                       "ok": all(r.ok for r in results)},
         "compile_key": {"fields": verdicts,
                         "ok": all(v.ok for v in verdicts)},
+        "content_key": {"fields": content,
+                        "ok": all(v.ok for v in content)},
     }
 
 
@@ -155,7 +162,8 @@ def run_all(paths: Optional[Iterable[str]] = None,
     if "contracts" in sections:
         passes = run_contract_pass(pipe, buckets=buckets)
         report.update(passes)
-        oks += [passes["contracts"]["ok"], passes["compile_key"]["ok"]]
+        oks += [passes["contracts"]["ok"], passes["compile_key"]["ok"],
+                passes["content_key"]["ok"]]
     if "collectives" in sections:
         coll = run_collectives_pass(pipe, collective_dps=collective_dps)
         report.update(coll)
@@ -185,6 +193,14 @@ def to_json_dict(report: dict) -> dict:
                         "key_changed": v.key_changed,
                         "ok": v.ok, "problem": v.problem}
                        for v in report["compile_key"]["fields"]]}
+    if "content_key" in report:
+        out["content_key"] = {
+            "ok": report["content_key"]["ok"],
+            "fields": [{"field": v.field,
+                        "output_determining": v.output_determining,
+                        "key_changed": v.key_changed,
+                        "ok": v.ok, "problem": v.problem}
+                       for v in report["content_key"]["fields"]]}
     if "collectives" in report:
         out["collectives"] = {
             "ok": report["collectives"]["ok"],
@@ -216,6 +232,14 @@ def render_text(report: dict, verbose: bool = False) -> str:
     if "compile_key" in report:
         k = report["compile_key"]
         lines.append(f"Compile-key sweep: "
+                     f"{sum(1 for v in k['fields'] if not v.ok)} "
+                     f"violation(s) across {len(k['fields'])} field(s)")
+        for v in k["fields"]:
+            if not v.ok or verbose:
+                lines.append("  " + v.format())
+    if "content_key" in report:
+        k = report["content_key"]
+        lines.append(f"Content-key sweep: "
                      f"{sum(1 for v in k['fields'] if not v.ok)} "
                      f"violation(s) across {len(k['fields'])} field(s)")
         for v in k["fields"]:

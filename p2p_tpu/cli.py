@@ -541,11 +541,31 @@ def cmd_serve(args) -> int:
                   "'sigterm' to start a drain — it only fires if the "
                   "operator drains (SIGTERM/SIGINT) mid-run",
                   file=sys.stderr)
+        if "kill_after_cache_insert" in kinds and not (args.cache
+                                                      and args.journal):
+            print("warning: chaos plan arms 'kill_after_cache_insert' but "
+                  "the insert window needs --cache AND --journal — the "
+                  "kill never fires and the durability path is NOT being "
+                  "drilled", file=sys.stderr)
     degrade = None
     if args.degrade_depth is not None:
         degrade = DegradeConfig(depth_threshold=args.degrade_depth,
                                 window_ms=args.degrade_window_ms,
                                 min_bucket=args.degrade_min_bucket)
+    semcache = None
+    if args.cache:
+        from .serve import SemCache
+
+        try:
+            semcache = SemCache(
+                spill_dir=args.cache_dir,
+                **({"l3_bytes": args.cache_l3_bytes}
+                   if args.cache_l3_bytes is not None else {}))
+        except ValueError as e:
+            raise SystemExit(str(e))
+    elif args.cache_dir is not None or args.cache_l3_bytes is not None:
+        raise SystemExit("--cache-dir/--cache-l3-bytes configure the "
+                         "semantic cache: they need --cache")
     slo = None
     if args.slo or args.tenant_quota is not None \
             or args.preempt_depth is not None:
@@ -605,6 +625,7 @@ def cmd_serve(args) -> int:
                     phase2_max_batch=args.phase2_max_batch,
                     mesh=mesh_spec,
                     slo=slo,
+                    semcache=semcache,
                     flight=flight_tracer,
                     lifecycle=drain_ctl,
                     snapshot_every_ms=args.snapshot_every_ms,
@@ -998,6 +1019,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "their phases spill their carry (journaled "
                         "'preempted' record) and resume when pressure "
                         "clears")
+    s.add_argument("--cache", action="store_true",
+                   help="enable content-addressed semantic caching "
+                        "(ISSUE 13): requests are keyed by every output-"
+                        "determining field and served from three layers — "
+                        "text-encoder outputs, phase-1 carry prefixes "
+                        "(a prefix hit enters the engine directly in "
+                        "phase 2) and bitwise exact results with single-"
+                        "flight collapsing of identical in-flight "
+                        "requests. Off (the default), the record stream, "
+                        "journal bytes and metric families are byte-"
+                        "identical to the cache-less engine — "
+                        "docs/SERVING.md#semantic-caching")
+    s.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="spill directory for the cache's L2/L3 sidecar "
+                        "files (content-addressed .npz; needs --cache; "
+                        "default: a fresh tempdir). With --journal, "
+                        "reusing the directory across restarts is what "
+                        "lets a journaled insert serve followers after a "
+                        "crash")
+    s.add_argument("--cache-l3-bytes", type=int, default=None, metavar="B",
+                   help="in-memory byte budget for the exact-result layer "
+                        "(LRU; eviction deletes the spill too; "
+                        "default 256 MiB)")
     s.set_defaults(fn=cmd_serve)
 
     c = sub.add_parser(

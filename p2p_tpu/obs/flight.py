@@ -69,8 +69,12 @@ from . import spans as spans_mod
 #: the span a request spent *parked* by the SLO scheduler's phase-boundary
 #: preemption (serve.scheduling) — split out of the hand-off wait so the
 #: scheduler owns its own milliseconds.
+#: ``cache_hit`` (ISSUE 13) is the whole lifetime of a request served from
+#: the semantic cache (an L3 exact hit or a single-flight follower): no
+#: compute ran, so the one stage owns [arrival, terminal] entirely.
 ATTRIBUTION_STAGES = ("queue_wait", "handoff_wait", "preempt_wait",
-                      "requeue_wait", "fault", "backoff", "compile", "run")
+                      "requeue_wait", "fault", "backoff", "compile", "run",
+                      "cache_hit")
 
 
 def trace_id(request_id: str, epoch: int) -> str:

@@ -26,8 +26,9 @@ from .lifecycle import DrainController, signal_drain
 from .meshing import MeshSpec, parse_mesh
 from .programs import ProgramCache
 from .queue import AdmissionQueue, Rejected
-from .request import Cancel, Request, parse_jsonl_line, prepare
+from .request import Cancel, Request, content_key, parse_jsonl_line, prepare
 from .scheduling import TIERS, FairClock, SloConfig
+from .semcache import SemCache
 
 __all__ = [
     "AdmissionQueue",
@@ -47,12 +48,14 @@ __all__ = [
     "ReplayState",
     "Request",
     "RetryPolicy",
+    "SemCache",
     "SimulatedKill",
     "SloConfig",
     "TIERS",
     "WatchdogTimeout",
     "bucket_for",
     "classify",
+    "content_key",
     "parse_jsonl_line",
     "parse_mesh",
     "prepare",

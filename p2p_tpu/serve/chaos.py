@@ -70,8 +70,14 @@ KILL_DURING_SNAPSHOT = "kill_during_snapshot"
 #: restart must resume the victim in phase 2 off the spill exactly like a
 #: crashed hand-off: exactly-once, bitwise-identical outputs.
 PREEMPT_THEN_KILL = "preempt_then_kill"
+#: ISSUE 13: die between a semantic-cache L3 insert and the leader's
+#: terminal fsync — the cache record and result spill are durable, the
+#: leader's terminal is not. The restart must reseed the cache off the
+#: journaled insert and serve the (still-pending) leader and followers
+#: from it: exactly-once, bitwise-identical to the uncached run.
+KILL_AFTER_CACHE_INSERT = "kill_after_cache_insert"
 LIFECYCLE_KINDS = (SIGTERM, KILL_DURING_DRAIN, KILL_DURING_SNAPSHOT,
-                   PREEMPT_THEN_KILL)
+                   PREEMPT_THEN_KILL, KILL_AFTER_CACHE_INSERT)
 
 KINDS = ("transient", "poison", "fatal", "hang", "nan") + LIFECYCLE_KINDS
 
@@ -134,7 +140,7 @@ class FaultPlan:
         drain-mode dispatch / the next snapshot's durable moment / the
         batch-boundary sync after a forced preemption)."""
         if kind not in (KILL_DURING_DRAIN, KILL_DURING_SNAPSHOT,
-                        PREEMPT_THEN_KILL):
+                        PREEMPT_THEN_KILL, KILL_AFTER_CACHE_INSERT):
             raise ValueError(f"not a kill kind: {kind!r}")
         self._armed_kills.add(kind)
 

@@ -328,6 +328,7 @@ def serve_forever(
     drain_timeout_ms: Optional[float] = None,
     mesh=None,
     slo=None,
+    semcache=None,
 ) -> Iterator[dict]:
     """Drain ``requests`` (Request/Cancel objects or JSONL-shaped dicts,
     sorted by ``arrival_ms``) through the queue → batcher → program-cache →
@@ -413,6 +414,22 @@ def serve_forever(
     ``slo=None`` (the default) changes nothing — not a record byte, a
     journal line, a compiled program or a metric family (the same
     disabled-mode discipline as chaos/flight/mesh).
+
+    ``semcache`` (None | ``serve.semcache.SemCache``) enables
+    content-addressed semantic caching (ISSUE 13, docs/SERVING.md
+    "Semantic caching"): requests are addressed by their
+    ``content_key`` (every output-determining field) and served from
+    three layers — L1 text-encoder outputs inside the runners, L2
+    phase-1 carry prefixes (a prefix hit enters the engine directly in
+    phase 2, riding the hand-off resume path), and L3 exact results
+    (bitwise, with single-flight collapsing: identical in-flight
+    requests ride one leader and each follower still gets its own
+    terminal record and flight trace). L3 inserts are journaled
+    (``cache`` records) so a restart reseeds the cache and serves a
+    killed leader's followers without recompute; under degradation the
+    L2 spill disk is shed *before* any request is. ``semcache=None``
+    (the default) changes nothing — not a record byte, a journal line,
+    a compiled program or a metric family.
     """
     from ..engine.sampler import lane_select
     from ..utils import progress as progress_mod
@@ -435,7 +452,7 @@ def serve_forever(
 
     make_runner = runner_factory or default_runner_factory(
         pipe, progress=progress, validate=validate_outputs,
-        heartbeat=watchdog_ms is not None, mesh=jmesh)
+        heartbeat=watchdog_ms is not None, mesh=jmesh, semcache=semcache)
     policy = retry_policy or RetryPolicy()
     queue = AdmissionQueue(queue_cap, slo=slo)
     if max_batch not in BUCKET_SIZES:
@@ -515,6 +532,15 @@ def serve_forever(
     # SLO-tiered scheduling state (serve.scheduling). With slo=None all
     # of this stays inert — `parked`/`forced_preempt` can only fill via a
     # chaos `preempt_then_kill` plan, which is itself non-default.
+    # Semantic-cache state (serve.semcache, ISSUE 13). With semcache=None
+    # every structure stays empty and every branch below it is skipped —
+    # the disabled-mode parity contract.
+    sc = semcache
+    leader_key: dict = {}       # leader request_id -> content digest
+    inflight_key: dict = {}     # content digest -> in-flight leader id
+    followers: dict = {}        # content digest -> waiting Entry list
+    ready_followers: List = []  # (Entry, images) awaiting emission
+    sc_served = {"l2": 0, "l3": 0, "collapsed": 0}
     parked: List[HandoffEntry] = []
     forced_preempt: set = set()      # chaos preempt_then_kill victims
     preemptions = 0
@@ -635,6 +661,17 @@ def serve_forever(
             "serve_tier_requests_total",
             "terminal records of admitted requests by SLO tier and status",
             labels=("tier", "status"))
+    # Semantic-cache serve counts exist only with an active SemCache, so a
+    # cache-less run's registry snapshot is byte-identical to the
+    # pre-cache engine's (the SemCache object owns the per-layer
+    # hit/miss/evict/bytes families the same way).
+    m_sc_serves = None
+    if sc is not None:
+        m_sc_serves = reg.counter(
+            "serve_semcache_served_total",
+            "requests served from the semantic cache by layer "
+            "('collapsed' = single-flight followers riding a leader)",
+            labels=("layer",))
     # Mesh families are created (and observed) only when a mesh is active:
     # a mesh-less run's registry snapshot carries no mesh rows at all
     # (the record stream / journal / program halves of disabled-mode
@@ -690,6 +727,11 @@ def serve_forever(
             fields.setdefault("replayed", True)
         if request_id in forced_gate_ids:
             fields.setdefault("degraded_gate", True)
+        if sc is not None and release:
+            # Single-flight leader resolution — BEFORE the terminal WAL
+            # line, so the journaled cache insert is strictly earlier than
+            # the leader's terminal (the kill_after_cache_insert window).
+            resolve_leader(request_id, status, fields)
         if journal is not None and journal_write:
             journal.terminal(request_id, status, vnow)
             journal.discard_carry(request_id)
@@ -736,6 +778,198 @@ def serve_forever(
         if warm is not None:
             warm(entries)
         return runner
+
+    # ------------------------------------------------------------------
+    # Semantic cache (serve.semcache): content-addressed admission, the
+    # single-flight leader/follower protocol, and follower emission. All
+    # of it is dead code with semcache=None.
+    # ------------------------------------------------------------------
+
+    def cache_admit(prep, now, *, replayed=False):
+        """Cache-side admission for one validated request: an L3 exact
+        hit serves it NOW (terminal record, no dispatch), an in-flight
+        leader with the same content key collapses it into a follower,
+        and an L2 prefix hit enters it directly in phase 2 (a hand-off
+        resume off the cached carry). Returns ``(records, kind)`` with
+        kind ∈ {None, "l3", "collapsed", "l2", "leader"}: None means
+        un-handled — the caller admits normally and registers the
+        content key's leader; "leader" means a presence test passed but
+        every load refused (corrupt spill, template mismatch), so the
+        already-admitted entry entered the pipeline as the key's leader
+        instead. Presence is tested BEFORE admission and cache counters
+        move only after it, so a ``Rejected`` (capacity / duplicate-id /
+        quota — cache-agnostic, raised exactly like ``queue.submit``)
+        never skews the hit/miss stats the bench sub-record reads."""
+        rid = prep.request.request_id
+        ck = sc.digest(prep.content_key)
+
+        def next_kind(skip_l3):
+            if not skip_l3 and sc.l3_has(ck):
+                return "l3"
+            if ck in inflight_key:
+                return "collapsed"
+            if prep.gated and phase_pools and sc.l2_has(ck):
+                return "l2"
+            return None
+
+        kind = next_kind(skip_l3=False)
+        if kind is None:
+            return [], None
+        entry = queue.admit_inflight(prep, now)
+        if slo is not None:
+            tier_by_id[rid] = slo.tier(prep.request)
+        if journal is not None and not replayed:
+            journal.admitted(prep.request.to_dict(), now)
+        if flight is not None:
+            flight.admit(rid, now, arrival_ms=entry.arrival_ms,
+                         gated=prep.gated and phase_pools,
+                         replayed=replayed)
+        if kind == "l3":
+            imgs = sc.l3_get(ck)      # counts the hit (corrupt ⇒ miss)
+            if imgs is not None:
+                if flight is not None:
+                    flight.wait(rid, "cache_hit", now, layer="l3")
+                sc_served["l3"] += 1
+                m_sc_serves.labels(layer="l3").inc()
+                return [record(
+                    "ok", rid, stage_phase="cached", images=imgs,
+                    arrival_ms=entry.arrival_ms,
+                    queue_wait_ms=now - entry.arrival_ms,
+                    compile_ms=0.0, run_ms=0.0,
+                    total_ms=now - entry.arrival_ms,
+                    gate_step=prep.gate_step,
+                    cache={"layer": "l3"})], "l3"
+            kind = next_kind(skip_l3=True) or "leader"
+        else:
+            sc.note_miss("l3")        # the admitted lookup really missed
+        if kind == "collapsed":
+            # Single-flight collapse: the leader computes once; this
+            # follower waits for the leader's terminal and gets its own
+            # record (and flight trace) off the leader's images.
+            if flight is not None:
+                flight.event(rid, "collapsed", now,
+                             leader=inflight_key[ck])
+            followers.setdefault(ck, []).append(entry)
+            return [], "collapsed"
+        if kind == "l2":
+            carry = sc.l2_get(ck, handoff_mod.carry_template(pipe, prep))
+            if carry is not None:
+                # A prefix hit IS a hand-off resume: phase 1 never runs.
+                if flight is not None:
+                    flight.event(rid, "cache_hit", now, layer="l2")
+                sc_served["l2"] += 1
+                m_sc_serves.labels(layer="l2").inc()
+                # The L2-served request is this content key's in-flight
+                # leader: later identical arrivals collapse onto it.
+                inflight_key[ck] = rid
+                leader_key[rid] = ck
+                batcher2.add(HandoffEntry(entry=entry, carry=carry,
+                                          handoff_ms=now,
+                                          cache_layer="l2"), now)
+                return [], "l2"
+        # Every load refused after admission (a rare corrupt window):
+        # the admitted entry becomes this content key's leader and
+        # computes normally — silent miss, never a fault.
+        inflight_key[ck] = rid
+        leader_key[rid] = ck
+        batcher.add(entry, now)
+        return [], "leader"
+
+    def register_leader(rid, prep) -> None:
+        ck = sc.digest(prep.content_key)
+        # An admitted request heading to compute IS an L3 lookup that
+        # missed (cache_admit tested presence without counting).
+        sc.note_miss("l3")
+        inflight_key[ck] = rid
+        leader_key[rid] = ck
+
+    def resolve_leader(request_id, status, fields) -> None:
+        """One in-flight leader reached a terminal (called from record(),
+        before the terminal WAL line). ``ok``: insert the result into L3
+        (journaled ``cache`` record — the chaos kill_after_cache_insert
+        window fires here, after the durable insert, before the terminal
+        fsync) and release the followers. Anything else: promote the
+        first follower into a fresh leader re-entering the pipeline —
+        a leader's cancellation/expiry/poison must never starve its
+        followers — except during a fatal or timed-out drain, where the
+        leftover sweeps resolve them instead."""
+        ck = leader_key.pop(request_id, None)
+        if ck is None:
+            return
+        if inflight_key.get(ck) == request_id:
+            del inflight_key[ck]
+        waiting = followers.pop(ck, [])
+        if status == "ok" and "images" in fields:
+            path = sc.l3_put(ck, fields["images"])
+            if path is not None and journal is not None:
+                journal.cache_insert(ck, request_id, path, vnow)
+            if chaos is not None and \
+                    chaos.take_kill(chaos_mod.KILL_AFTER_CACHE_INSERT):
+                # Die with the insert (and its WAL record) durable but
+                # the leader's terminal unwritten — the restart reseeds
+                # the cache off the journal and serves leader+followers
+                # from it without recompute.
+                if journal is not None:
+                    journal.sync()
+                raise chaos_mod.SimulatedKill(
+                    "chaos kill_after_cache_insert")
+            for f in waiting:
+                ready_followers.append((f, fields["images"]))
+        elif waiting:
+            if fatal_reason[0] is not None or drain_timed_out:
+                followers[ck] = waiting   # the drain sweeps resolve them
+                return
+            promoted = waiting[0]
+            leader_key[promoted.request_id] = ck
+            inflight_key[ck] = promoted.request_id
+            if waiting[1:]:
+                followers[ck] = waiting[1:]
+            if flight is not None:
+                flight.event(promoted.request_id, "promoted", vnow,
+                             leader=request_id)
+            batcher.add(promoted, vnow)
+
+    def flush_followers() -> Iterator[dict]:
+        """Emit the terminal records of followers whose leader resolved
+        ok. Runs at cycle boundaries (and at the drain/fatal sweeps):
+        cancellation and deadline expiry are checked NOW, exactly like a
+        dispatching batch — a follower is a real request with its own
+        lifecycle, not an alias of its leader."""
+        while ready_followers:
+            entry, imgs = ready_followers.pop(0)
+            rid = entry.request_id
+            if queue.is_cancelled(rid):
+                yield record("cancelled", rid, arrival_ms=entry.arrival_ms,
+                             queue_wait_ms=vnow - entry.arrival_ms)
+            elif queue_mod.expired(entry, vnow):
+                yield record(
+                    "expired", rid, arrival_ms=entry.arrival_ms,
+                    reason=(f"deadline {entry.request.deadline_ms}ms "
+                            f"passed while collapsed on an in-flight "
+                            f"leader (waited "
+                            f"{vnow - entry.arrival_ms:.1f}ms)"))
+            else:
+                sc_served["collapsed"] += 1
+                m_sc_serves.labels(layer="collapsed").inc()
+                if flight is not None:
+                    flight.wait(rid, "cache_hit", vnow, layer="l3",
+                                collapsed=True)
+                yield record(
+                    "ok", rid, stage_phase="cached", images=imgs,
+                    arrival_ms=entry.arrival_ms,
+                    queue_wait_ms=vnow - entry.arrival_ms,
+                    compile_ms=0.0, run_ms=0.0,
+                    total_ms=vnow - entry.arrival_ms,
+                    gate_step=entry.prepared.gate_step,
+                    cache={"layer": "l3", "collapsed": True})
+
+    def drain_follower_entries() -> List:
+        """Pull every not-yet-ready follower out of the single-flight
+        maps — the fatal-drain / drain-timeout sweeps resolve them with
+        everything else outstanding (nothing may silently vanish)."""
+        out = [f for fl in followers.values() for f in fl]
+        followers.clear()
+        return out
 
     def take_chaos(batch_idx, rids):
         """Chaos consultation shared by every dispatch site. Lifecycle
@@ -801,6 +1035,16 @@ def serve_forever(
         replay_skip = set(rs.terminal) | set(rs.pending_ids)
         restore_degrade_level = rs.degrade_level if degrade is not None \
             else 0
+        if sc is not None:
+            # Reseed the L3 index from the journaled cache records: a
+            # leader killed between its insert and its terminal fsync
+            # left a durable result the restart serves followers from.
+            # Run even with zero records — the journal is the authority
+            # over a reused spill dir, so seed() sweeps r-* files no
+            # replayed insert references (the disk-reclaim path).
+            seeded = sc.seed(rs.cache_entries)
+            if seeded:
+                m_replay.labels(kind="cache_seeded").inc(seeded)
         if rs.orphans_swept:
             m_gc.labels(kind="spill_orphan").inc(rs.orphans_swept)
         if rs.segments_swept:
@@ -838,6 +1082,23 @@ def serve_forever(
                         req = dataclasses.replace(req, arrival_ms=0.0)
                         prep = prepare(req, pipe)
                         rid = req.request_id
+                        if sc is not None:
+                            replayed_ids.add(rid)
+                            recs, ckind = cache_admit(prep, 0.0,
+                                                      replayed=True)
+                            if ckind is not None:
+                                # "l3"/"l2" really served off the reseeded
+                                # cache; a collapsed follower or a
+                                # corrupt-entry leader recomputes — count
+                                # it as what it is, not as a hit.
+                                m_replay.labels(kind={
+                                    "l3": "cache_hit", "l2": "cache_hit",
+                                    "collapsed": "collapsed",
+                                    "leader": "pending"}[ckind]).inc()
+                                for r in recs:
+                                    yield r
+                                continue
+                            replayed_ids.discard(rid)  # re-added below
                         ho = rs.handoffs.get(rid)
                         if (ho is not None and prep.gated and phase_pools):
                             # The WAL says phase 1 already ran: resume in
@@ -857,6 +1118,8 @@ def serve_forever(
                                 entry = queue.admit_inflight(prep, 0.0)
                                 if slo is not None:
                                     tier_by_id[rid] = slo.tier(req)
+                                if sc is not None:
+                                    register_leader(rid, prep)
                                 batcher2.add(HandoffEntry(
                                     entry=entry, carry=carry,
                                     handoff_ms=0.0, resumed=True), 0.0)
@@ -873,6 +1136,8 @@ def serve_forever(
                         queue.submit(prep, 0.0)
                         if slo is not None:
                             tier_by_id[rid] = slo.tier(req)
+                        if sc is not None:
+                            register_leader(rid, prep)
                         replayed_ids.add(rid)
                         m_replay.labels(kind="pending").inc()
                         if flight is not None:
@@ -1324,6 +1589,13 @@ def serve_forever(
                         and validate_outputs) else set())
         carries = handoff_mod.lane_carries(carry_g, len(entries))
         for e, c in zip(entries, carries):
+            if sc is not None:
+                # L2 prefix insert: the carry is a pure function of the
+                # content key, so a later identical request skips phase 1
+                # entirely (content-addressed spill, LRU-bounded; the
+                # journal spill below is the CRASH copy, this is the
+                # cross-request one).
+                sc.l2_put(sc.digest(e.prepared.content_key), c)
             p1 = {"batch_id": batch_id, "lanes": lanes,
                   "occupancy": occupancy,
                   "queue_wait_ms": dispatch_ms - e.arrival_ms,
@@ -1724,7 +1996,11 @@ def serve_forever(
             stage["run_ms"].labels(phase="phase1").observe(
                 float(p1["run_ms"]))
         else:
-            phases["phase1"] = {"resumed": True}
+            # No phase-1 dispatch this incarnation: either a crash-replay
+            # resume off the journal spill, or a semantic-cache L2 prefix
+            # hit (the cached carry stood in for phase 1 entirely).
+            phases["phase1"] = ({"cached": True} if e.cache_layer == "l2"
+                                else {"resumed": True})
         if e.resumed:
             phases["resumed"] = True
         if e.preempt_wait_ms:
@@ -1738,6 +2014,8 @@ def serve_forever(
         stage["run_ms"].labels(phase="phase2").observe(run_ms)
         stage["total_ms"].labels(phase="gated").observe(latency)
         extra = {"isolated_retry": True} if isolated else {}
+        if e.cache_layer is not None:
+            extra["cache"] = {"layer": e.cache_layer}
         return record(
             "ok", e.request_id, stage_phase=None, images=image,
             arrival_ms=e.arrival_ms,
@@ -2004,6 +2282,18 @@ def serve_forever(
                 if flight is not None:
                     flight.loop_event("degrade", vnow, level=degrade_level,
                                       depth=depth)
+                if sc is not None and degrade_level >= 2:
+                    # Eviction joins the ladder: spill disk is cheaper
+                    # than any request — the L2 prefix store is shed one
+                    # rung BEFORE level 3 starts shedding traffic (its
+                    # entries rebuild from hand-offs once pressure
+                    # clears; exact results and embeddings are kept —
+                    # they are what absorbs the overload).
+                    shed_entries = sc.shed_l2()
+                    if shed_entries and journal is not None:
+                        journal.event("cache_shed", layer="l2",
+                                      entries=shed_entries,
+                                      vnow_ms=round(vnow, 3))
                 _apply_degrade_level()
         else:
             pressure_since = None
@@ -2102,7 +2392,15 @@ def serve_forever(
                 item = dataclasses.replace(item, gate="auto")
             try:
                 prep = prepare(item, pipe)
+                if sc is not None:
+                    recs, ckind = cache_admit(prep, vnow)
+                    if ckind is not None:
+                        for r in recs:
+                            yield r
+                        continue
                 queue.submit(prep, vnow)
+                if sc is not None:
+                    register_leader(item.request_id, prep)
                 if slo is not None:
                     tier_by_id[item.request_id] = slo.tier(item)
                 if forced_gate:
@@ -2185,6 +2483,10 @@ def serve_forever(
         # resume when it clears (a no-op without an SloConfig or a chaos
         # forced preemption).
         yield from preemption_cycle()
+        # 2.6 Single-flight followers whose leader resolved last cycle get
+        # their terminals (cancel/expiry checked at emission).
+        if sc is not None:
+            yield from flush_followers()
         # 3. Flush whatever is due — phase-2 pool first: finishing
         # nearly-done requests frees outstanding slots and bounds their
         # p95 before new phase-1 work starts (the continuous-batching
@@ -2217,6 +2519,11 @@ def serve_forever(
             batches2 = batcher2.flush_all(vnow)
             batches = batcher.flush_all(vnow)
             if not batches and not batches2:
+                if sc is not None and ready_followers:
+                    # The pipeline is not empty while a resolved leader's
+                    # followers still await their terminals.
+                    yield from flush_followers()
+                    continue
                 break
         ordered = ([("phase2", b) for b in batches2]
                    + [("phase1", b) for b in batches])
@@ -2245,6 +2552,13 @@ def serve_forever(
                              for e in b.entries]
                 leftover += parked
                 parked.clear()
+                if sc is not None:
+                    # Ready followers have their images in hand: serve
+                    # them even on a timed-out drain. The rest sweep with
+                    # everything outstanding (journaled: stay pending;
+                    # else: explicit draining rejections).
+                    yield from flush_followers()
+                    leftover += drain_follower_entries()
                 leftover += queue.drain()
                 if journal is not None:
                     journal.event("drain_timeout", pending=len(leftover),
@@ -2317,6 +2631,14 @@ def serve_forever(
                              for e in b.entries]
                 leftover += parked
                 parked.clear()
+                if sc is not None:
+                    # Followers whose leader already resolved ok have the
+                    # images in hand — served even on a fatal drain; the
+                    # rest fail with everything outstanding (promotion is
+                    # suppressed under a fatal, so resolve_leader leaves
+                    # them in the follower map for this sweep).
+                    yield from flush_followers()
+                    leftover += drain_follower_entries()
                 leftover += queue.drain()
                 for e in leftover:
                     yield record(
@@ -2469,6 +2791,15 @@ def serve_forever(
             "deadline_jumps": deadline_jumps,
             "tier_yields": tier_yields,
             "quota_rejects": quota_rejects,
+        }
+    if sc is not None:
+        # Present only under an active SemCache, so cache-less summaries
+        # stay byte-identical (disabled-mode parity).
+        summary["semcache"] = {
+            "layers": sc.layer_stats(),
+            "served": dict(sc_served),
+            "served_from_cache": (sc_served["l2"] + sc_served["l3"]
+                                  + sc_served["collapsed"]),
         }
     if replay_info is not None:
         summary["replay"] = replay_info

@@ -63,6 +63,11 @@ class HandoffEntry:
     #: is trivially bitwise.
     preempted_ms: Optional[float] = None
     preempt_wait_ms: float = 0.0
+    #: ISSUE 13: this entry entered phase 2 off a semantic-cache prefix
+    #: hit ("l2") instead of a phase-1 dispatch — a prefix hit IS a
+    #: hand-off resume, surfaced as ``phases.phase1.cached`` in the
+    #: record rather than ``resumed`` (which names the crash-replay path).
+    cache_layer: Optional[str] = None
 
     @property
     def prepared(self):

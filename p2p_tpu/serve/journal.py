@@ -10,6 +10,9 @@ writes one JSON line per request-state transition —
   path + pinned treedef spec ride the record — a restart resumes the
   request in phase 2 off the spill instead of re-running phase 1
 - ``terminal``   — request id + final status, when the record is emitted
+- ``cache``      — a semantic-cache L3 insert (content digest + result
+  spill path), written before its leader's ``terminal`` so a crash in
+  between still lets the restart serve the followers from the cache
 - ``event``      — loop-level transitions (degradation level changes)
 
 — buffered in userspace and :meth:`Journal.sync`'d (flush + ``os.fsync``)
@@ -73,6 +76,13 @@ HANDOFF = "handoff"
 #: preempted-then-killed request resumes in phase 2 off the spill, the
 #: same fold, the same exactly-once contract (docs/SERVING.md).
 PREEMPTED = "preempted"
+#: ISSUE 13: a semantic-cache L3 insert — the content-key digest, the
+#: leader's request id and the (already durable) result-spill path.
+#: Replay folds these into ``ReplayState.cache_entries`` so a restarted
+#: engine reseeds its cache index (``SemCache.seed``) and serves a killed
+#: leader's followers without recompute: the journal's dedupe map
+#: generalized from trace-ids to content keys.
+CACHE = "cache"
 TERMINAL = "terminal"
 EVENT = "event"
 
@@ -119,6 +129,10 @@ class ReplayState:
     #: removed during this fold.
     orphans_swept: int = 0
     segments_swept: int = 0
+    #: content-key digest -> its last ``cache`` record (result-spill path):
+    #: the semantic cache's durable index (empty unless the previous
+    #: incarnation ran with ``--cache``).
+    cache_entries: Dict[str, dict] = dataclasses.field(default_factory=dict)
 
     @property
     def pending_ids(self):
@@ -150,6 +164,12 @@ def _load_snapshot(spath: str):
                 and all(isinstance(h, dict) and h.get("carry_path")
                         for h in snap["handoffs"].values())):
             raise ValueError("bad handoffs")
+        # Optional (ISSUE 13): absent from every cache-less snapshot, so
+        # pre-cache snapshots (and cache-off runs) stay byte-identical.
+        if not (isinstance(snap.get("cache", {}), dict)
+                and all(isinstance(r, dict) and r.get("path")
+                        for r in snap.get("cache", {}).values())):
+            raise ValueError("bad cache")
         int(snap.get("seq", 0))
         int(snap.get("degrade_level", 0))
         int(snap.get("folded_records", 0))
@@ -230,6 +250,7 @@ def replay(path: str, *, sweep: bool = True) -> ReplayState:
                 order.append(rid)
         state.terminal.update(snap["terminal"])
         state.handoffs.update(snap["handoffs"])
+        state.cache_entries.update(snap.get("cache", {}))
 
     def fold_file(p: str) -> None:
         with open(p, "r", encoding="utf-8", errors="replace") as f:
@@ -274,6 +295,12 @@ def replay(path: str, *, sweep: bool = True) -> ReplayState:
                         state.skipped_corrupt += 1
                         continue
                     state.handoffs[rid] = rec  # last hand-off wins (retries)
+                elif kind == CACHE:
+                    key = rec.get("key")
+                    if not key or not rec.get("path"):
+                        state.skipped_corrupt += 1
+                        continue
+                    state.cache_entries[key] = rec  # last insert wins
                 elif kind in (DISPATCHED, EVENT):
                     # Informational for replay — except the degradation
                     # transitions, which the warm restart resumes.
@@ -385,6 +412,18 @@ class Journal:
         except OSError:
             pass
 
+    def cache_insert(self, key: str, request_id: str, path: str,
+                     vnow: float) -> None:
+        """One semantic-cache L3 insert (ISSUE 13): ``key`` is the content
+        digest, ``path`` the result spill (already durably written by
+        ``SemCache.l3_put`` — tmp+fsync+rename — so this record can never
+        point at a file a crash loses). Appended *before* the leader's
+        terminal line: the ``kill_after_cache_insert`` chaos window is a
+        durable insert with no terminal, which replay must serve the
+        followers from."""
+        self._append({"type": CACHE, "key": key, "id": request_id,
+                      "path": path, "vnow_ms": round(vnow, 3)})
+
     def terminal(self, request_id: str, status: str, vnow: float) -> None:
         self._append({"type": TERMINAL, "id": request_id, "status": status,
                       "vnow_ms": round(vnow, 3)})
@@ -435,6 +474,14 @@ class Journal:
                 "degrade_level": int((extra or {}).get(
                     "degrade_level", state.degrade_level)),
                 "folded_records": state.folded_records}
+        # Cache index entries whose spill still exists (eviction deletes
+        # the file but cannot rewrite history — the snapshot drops the
+        # stale pointer instead). Key absent when empty, so cache-less
+        # snapshots stay byte-identical to the pre-cache schema.
+        cache = {k: r for k, r in state.cache_entries.items()
+                 if os.path.exists(str(r.get("path", "")))}
+        if cache:
+            snap["cache"] = cache
         spath = self.path + SNAPSHOT_SUFFIX
         tmp = spath + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:

@@ -142,13 +142,19 @@ class SweepRunner:
 
     def __init__(self, pipe, compile_key: Tuple, bucket: int,
                  progress: bool = False, validate: bool = False,
-                 heartbeat: bool = False, mesh=None):
+                 heartbeat: bool = False, mesh=None, semcache=None):
         self.pipe = pipe
         (_, self.steps, self.scheduler, self.gate_step, self.group_batch,
          _) = compile_key
         self.bucket = bucket
         self.progress = progress
         self.validate = validate
+        # ISSUE 13: the semantic cache's L1 layer — cond/uncond embeddings
+        # are pure functions of (model, prompts), so repeated prompts skip
+        # the text encoder. semcache=None (default) encodes every lane
+        # exactly as before; a cached value is the same device array the
+        # encoder produced, so reuse is bitwise by construction.
+        self.semcache = semcache
         # A live jax.sharding.Mesh (or None): the sweep shards the lane
         # axis over its dp axis. Inputs are still assembled on the default
         # device; the sweep entry points stage them onto the mesh with
@@ -169,12 +175,19 @@ class SweepRunner:
 
         from ..engine.sampler import encode_prompts, init_latent, stage_host
 
+        def encode(prompts):
+            if self.semcache is None:
+                return encode_prompts(self.pipe, list(prompts))
+            return self.semcache.l1_get_or_build(
+                (self.pipe.config.name,) + tuple(prompts),
+                lambda: encode_prompts(self.pipe, list(prompts)))
+
         ctxs, lats, ctrls = [], [], []
         for e in entries:
             req = e.request
-            cond = encode_prompts(self.pipe, list(req.prompts))
-            uncond = encode_prompts(
-                self.pipe, [req.negative_prompt or ""] * len(req.prompts))
+            cond = encode(req.prompts)
+            uncond = encode(tuple([req.negative_prompt or ""]
+                                  * len(req.prompts)))
             ctxs.append(jnp.concatenate([uncond, cond], axis=0))
             # The seed is staged explicitly (np.int32 is exactly what
             # PRNGKey(int) resolves to under x64-off, so keys — and lanes —
@@ -272,11 +285,12 @@ class Phase1Runner(SweepRunner):
 
     def __init__(self, pipe, compile_key: Tuple, bucket: int,
                  progress: bool = False, validate: bool = False,
-                 heartbeat: bool = False, mesh=None):
+                 heartbeat: bool = False, mesh=None, semcache=None):
         # Strip the "phase1" pool tag; the rest is the monolithic key
         # layout SweepRunner already parses.
         super().__init__(pipe, compile_key[1:], bucket, progress=progress,
-                         validate=validate, heartbeat=heartbeat, mesh=mesh)
+                         validate=validate, heartbeat=heartbeat, mesh=mesh,
+                         semcache=semcache)
 
     def _run(self, ctx, lat, ctrl, guidance: float):
         from ..parallel.sweep import sweep_phase1
@@ -326,7 +340,9 @@ class Phase2Runner:
 
     def __init__(self, pipe, compile_key: Tuple, bucket: int,
                  progress: bool = False, validate: bool = False,
-                 heartbeat: bool = False, mesh=None):
+                 heartbeat: bool = False, mesh=None, semcache=None):
+        # semcache accepted for factory uniformity; phase 2 never encodes
+        # (the hand-off unit already carries the cond context).
         self.pipe = pipe
         (_, _, self.steps, self.scheduler, self.gate_step, self.group_batch,
          _) = compile_key
@@ -436,7 +452,7 @@ class Phase2Runner:
 
 def default_runner_factory(pipe, progress: bool = False,
                            validate: bool = False, heartbeat: bool = False,
-                           mesh=None):
+                           mesh=None, semcache=None):
     """The engine's default ``runner_factory``: real sweeps on ``pipe``.
     Dispatches on the compile key's pool tag — ``("phase1", ...)`` /
     ``("phase2", ...)`` keys build the disaggregated pool runners,
@@ -460,7 +476,7 @@ def default_runner_factory(pipe, progress: bool = False,
 
         compile_key = strip_mesh_key(compile_key)
         kw = dict(progress=progress, validate=validate, heartbeat=heartbeat,
-                  mesh=mesh)
+                  mesh=mesh, semcache=semcache)
         tag = compile_key[0] if compile_key else None
         if tag == "phase1":
             return Phase1Runner(pipe, compile_key, bucket, **kw)

@@ -24,6 +24,18 @@ surface can never accept a spec the direct surface would refuse.
   *shared* across a sweep call (guidance scale): requests may share a
   compiled program yet not a batch.
 
+- ``content_key`` — the *semantic cache* address (ISSUE 13): every field
+  that determines the request's **output images** — prompts, edit values,
+  seed, steps, scheduler, guidance, negative prompt, resolved gate step —
+  and nothing that doesn't (``request_id``, arrival/deadline, priority,
+  tenant, tier are pure scheduling metadata). Two requests sharing a
+  content key produce bitwise-identical images, so one may be served the
+  other's result; a field missing from the key would serve *wrong* images
+  (cache poisoning), a superfluous one would split identical traffic
+  (lost hits). The ``OUTPUT_DETERMINING`` sweep in
+  ``analysis.compile_key`` guards both directions per field, the same
+  completeness idiom that covers ``compile_key``.
+
 Gated requests (resolved gate step < scan length) additionally carry the
 **per-phase** keys of the disaggregated program pools:
 
@@ -50,6 +62,20 @@ from . import scheduling
 
 _SCHEDULERS = ("ddim", "plms", "dpm")
 _MODES = ("replace", "refine")
+
+#: The partition of Request fields by OUTPUT identity (ISSUE 13).
+#: ``CONTENT_FIELDS`` determine the images a request produces and feed the
+#: semantic-cache ``content_key``; ``SCHEDULING_FIELDS`` never do (they
+#: decide *when/whether* a request runs, not *what* it computes). The two
+#: tuples must cover the schema exactly — ``content_key`` errors on a
+#: field in neither, so extending the schema forces a cache-identity
+#: decision (the compile-key completeness discipline).
+CONTENT_FIELDS = ("prompt", "target", "mode", "cross_steps", "self_steps",
+                  "blend_words", "equalizer", "blend_resolution", "seed",
+                  "steps", "scheduler", "guidance", "negative_prompt",
+                  "gate")
+SCHEDULING_FIELDS = ("request_id", "arrival_ms", "deadline_ms", "priority",
+                     "tenant", "tier")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -187,10 +213,40 @@ def controller_signature(controller) -> Tuple:
                   for x in leaves))
 
 
+def content_key(req: Request, gate_step: int, model_name: str) -> Tuple:
+    """The semantic-cache address: every output-determining field, nothing
+    else (ISSUE 13). Keyed on the *resolved* gate step, not the raw spec —
+    ``gate=0.5`` and ``gate=2`` at ``steps=4`` run the identical
+    trajectory and must share one cache line. Edit knobs (mode, windows,
+    blend, equalizer) only shape the output when a ``target`` builds a
+    controller, so a pure generation normalizes them away — two
+    generations differing only in an ignored ``mode`` are the same
+    traffic. Errors if the schema grew a field outside the declared
+    CONTENT/SCHEDULING partition: a new field must decide its cache
+    identity before it can ride a cached serve."""
+    declared = set(CONTENT_FIELDS) | set(SCHEDULING_FIELDS)
+    fields = {f.name for f in dataclasses.fields(Request)}
+    if fields != declared:
+        raise ValueError(
+            f"Request fields {sorted(fields ^ declared)} are missing from "
+            "the CONTENT_FIELDS/SCHEDULING_FIELDS partition: decide "
+            "whether they determine the output before caching can serve "
+            "this schema")
+    edit = (None if req.target is None else
+            (req.target, req.mode, float(req.cross_steps),
+             float(req.self_steps), req.blend_words, req.equalizer,
+             int(req.blend_resolution)))
+    return ("content", model_name, req.prompt, edit, int(req.seed),
+            int(req.steps), req.scheduler, float(req.guidance),
+            req.negative_prompt, int(gate_step))
+
+
 @dataclasses.dataclass(frozen=True)
 class PreparedRequest:
     """A validated request bound to a pipeline: controller built, gate
-    resolved, batching keys derived (monolithic + per-phase pool keys)."""
+    resolved, batching keys derived (monolithic + per-phase pool keys),
+    plus the semantic-cache ``content_key`` (always derived — a pure
+    tuple — but only *read* when a ``SemCache`` is active)."""
 
     request: Request
     controller: Any
@@ -201,6 +257,7 @@ class PreparedRequest:
     phase1_key: Optional[Tuple] = None      # None = ungated (single-pool)
     phase2_key: Optional[Tuple] = None
     phase2_batch_key: Optional[Tuple] = None
+    content_key: Optional[Tuple] = None
 
     @property
     def gated(self) -> bool:
@@ -258,4 +315,6 @@ def prepare(req: Request, pipe) -> PreparedRequest:
                            gate_step=gate_step, scan_steps=scan_steps,
                            compile_key=compile_key, batch_key=batch_key,
                            phase1_key=phase1_key, phase2_key=phase2_key,
-                           phase2_batch_key=phase2_batch_key)
+                           phase2_batch_key=phase2_batch_key,
+                           content_key=content_key(req, gate_step,
+                                                   pipe.config.name))

@@ -86,15 +86,32 @@ SERVE_SLO_KEYS = {
 }
 
 
+#: ISSUE 13: the serve block's `cache` sub-record — the seeded --zipf 1.1
+#: cached-vs-uncached parity drill. Frozen literal: amplification is a
+#: benchwatch headline key (img/s served cached over uncached at equal
+#: device-seconds of demand, higher is better), and the per-layer hit
+#: counts/rates record that all three cache layers actually worked.
+SERVE_CACHE_KEYS = {
+    "n_requests", "zipf_s",
+    "served_from_cache", "served_from_cache_fraction",
+    "l1_hits", "l2_hits", "l3_hits",
+    "l1_hit_rate", "l2_hit_rate", "l3_hit_rate",
+    "l3_evictions", "collapsed",
+    "uncached_makespan_ms", "cached_makespan_ms", "amplification",
+}
+
+
 def test_rehearsal_schema_unchanged_by_static_analysis_pr():
     """ISSUE 5 was a static-analysis PR, ISSUE 6 a serve-architecture PR,
-    ISSUE 10 a mesh-serving PR and ISSUE 12 an SLO-scheduling PR: the
-    top-level rehearsal schema stays exactly the PR-4 set (ISSUE 6 grows
-    the serve block's NESTED `phases` sub-record — SERVE_PHASES_KEYS —
-    ISSUE 10 its NESTED `mesh` sub-record — SERVE_MESH_KEYS — and
-    ISSUE 12 its NESTED `slo` sub-record — SERVE_SLO_KEYS). A future PR
-    that grows the schema updates the frozen copies (and EXPECTED_KEYS,
-    and bench._BLOCK_KEYS) in the same diff, deliberately."""
+    ISSUE 10 a mesh-serving PR, ISSUE 12 an SLO-scheduling PR and
+    ISSUE 13 a semantic-caching PR: the top-level rehearsal schema stays
+    exactly the PR-4 set (ISSUE 6 grows the serve block's NESTED `phases`
+    sub-record — SERVE_PHASES_KEYS — ISSUE 10 its NESTED `mesh`
+    sub-record — SERVE_MESH_KEYS — ISSUE 12 its NESTED `slo` sub-record
+    — SERVE_SLO_KEYS — and ISSUE 13 its NESTED `cache` sub-record —
+    SERVE_CACHE_KEYS). A future PR that grows the schema updates the
+    frozen copies (and EXPECTED_KEYS, and bench._BLOCK_KEYS) in the same
+    diff, deliberately."""
     assert EXPECTED_KEYS == {
         "metric", "value", "unit", "vs_baseline", "variant", "platform",
         "single_group_imgs_per_s",
@@ -596,6 +613,20 @@ def test_bench_rehearsal_green_and_complete():
     assert sb["paid_shed"] == 0
     assert sb["preemptions"] >= 1
     assert sb["quota_rejects"] >= 1
+    # Semantic-caching acceptance (ISSUE 13): the zipf parity drill served
+    # a real fraction of the trace from cache (the drill itself raises
+    # unless every cached serve is bitwise-identical to its uncached
+    # twin), every layer hit, the tight L3 budget actually evicted, and
+    # the measured img/s amplification — the benchwatch headline — is
+    # recorded. Amplification is the one serve win honestly measurable at
+    # CPU rehearsal: a cache hit costs no compute on any backend.
+    cb = doc["serve"]["cache"]
+    assert set(cb) == SERVE_CACHE_KEYS
+    assert cb["served_from_cache_fraction"] >= 0.3
+    assert cb["l1_hits"] >= 1 and cb["l2_hits"] >= 1 and cb["l3_hits"] >= 1
+    assert cb["l3_evictions"] >= 1
+    assert cb["amplification"] > 1.0
+    assert cb["uncached_makespan_ms"] > cb["cached_makespan_ms"]
     mb = doc["serve"]["mesh"]
     assert set(mb) == SERVE_MESH_KEYS
     assert mb["devices"] >= 2            # the virtual mesh really spanned

@@ -902,7 +902,7 @@ def test_report_verdict_flips_on_contract_class_violation(tmp_path,
     # the AST leg of that mapping is covered by the subprocess test above.
     # This closes the contract leg: a failing contract (or compile-key
     # verdict) must flip run_all's verdict even with a clean AST pass.
-    from p2p_tpu.analysis.compile_key import FieldVerdict
+    from p2p_tpu.analysis.compile_key import ContentVerdict, FieldVerdict
     from p2p_tpu.analysis.contracts import ContractResult
 
     def seeded_failure(*a, **kw):
@@ -912,6 +912,9 @@ def test_report_verdict_flips_on_contract_class_violation(tmp_path,
                 "scan0: 1 callback(s) with telemetry off")], "ok": False},
             "compile_key": {"fields": [FieldVerdict(
                 "gate", program_changed=True, key_changed=False)],
+                "ok": False},
+            "content_key": {"fields": [ContentVerdict(
+                "seed", output_determining=True, key_changed=False)],
                 "ok": False},
         }
 
@@ -927,6 +930,7 @@ def test_report_verdict_flips_on_contract_class_violation(tmp_path,
     assert rep["ok"] is False
     text = report_mod.render_text(rep)
     assert "FAILED" in text and "poisoning" in text
+    assert "served another request's images" in text  # content-key leg
 
 
 def test_report_ok_verdict_and_json_shape(tmp_path):

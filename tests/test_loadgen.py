@@ -159,6 +159,113 @@ def test_tenant_and_tier_mix_schema_and_determinism():
         lg.parse_name_mix(" , ")
 
 
+def test_zipf_popularity_mode_discipline_and_prefix_stability():
+    """ISSUE 13 satellite pin: --zipf draws each request's IDENTITY
+    (prompt pair + seed — its semantic-cache content) from a Zipf(s) rank
+    distribution on SEPARATE derived RNG streams, so arrivals, deadlines
+    and every other mix stay byte-identical to the non-zipf trace — and
+    the streaming prefix contract holds under it."""
+    import itertools
+
+    lg = _loadgen()
+    base = lg.generate_trace(48, seed=5, steps=4, deadline_ms=400.0)
+    zipf = lg.generate_trace(48, seed=5, steps=4, deadline_ms=400.0,
+                             zipf_s=1.1, zipf_universe=8)
+    assert zipf == lg.generate_trace(48, seed=5, steps=4,
+                                     deadline_ms=400.0, zipf_s=1.1,
+                                     zipf_universe=8)  # deterministic
+    # Only the identity fields (prompt/target/seed) may differ.
+    for b, z in zip(base, zipf):
+        assert {k: v for k, v in z.items()
+                if k not in ("prompt", "target", "seed")} == \
+            {k: v for k, v in b.items()
+             if k not in ("prompt", "target", "seed")}
+    # Popularity is real: 8 identities over 48 requests repeat, skewed —
+    # the head identity strictly dominates a uniform share.
+    idents = [(z["prompt"], z["seed"]) for z in zipf]
+    assert len(set(idents)) <= 8 < len(idents)
+    head = max(set(idents), key=idents.count)
+    assert idents.count(head) > len(idents) / 8
+    # Identity table is horizon-independent (prefix stability): the same
+    # identities appear whatever n, and the stream form matches.
+    assert lg.generate_trace(16, seed=5, steps=4, deadline_ms=400.0,
+                             zipf_s=1.1, zipf_universe=8) == zipf[:16]
+    assert list(itertools.islice(
+        lg.generate_stream(None, seed=5, steps=4, deadline_ms=400.0,
+                           zipf_s=1.1, zipf_universe=8), 24)) == zipf[:24]
+    # The zipf stream never perturbs the other mixes (own-stream rule).
+    gmix = lg.parse_gate_mix("0.5:1,off:1")
+    gated = lg.generate_trace(32, seed=5, steps=4, gate_mix=gmix)
+    both = lg.generate_trace(32, seed=5, steps=4, gate_mix=gmix,
+                             zipf_s=1.1, zipf_universe=8)
+    assert [m.get("gate") for m in both] == [g.get("gate") for g in gated]
+    # A zipf trace is valid serve schema end to end.
+    from p2p_tpu.serve import Request
+
+    assert all(Request.from_dict(d) for d in zipf)
+    with pytest.raises(ValueError, match="zipf s must be positive"):
+        lg.generate_trace(4, zipf_s=0.0)
+    with pytest.raises(ValueError, match="zipf universe"):
+        lg.generate_trace(4, zipf_s=1.1, zipf_universe=0)
+
+
+def test_cross_tool_seed_stability_pins():
+    """ISSUE 13 bugfix satellite: the PR-8 per-request draw-order change
+    silently shifted every tool's seeded workload once — this pin makes
+    the next loadgen RNG refactor loud instead. Audit of every in-repo
+    trace constructor (chaos_drill.standard_trace / slo_overload_drill /
+    cache_parity_drill, tools/soak.py, bench.py serve blocks): all ride
+    ``generate_trace``/``generate_stream``, which share one per-request
+    draw path (``generate_trace`` IS ``list(generate_stream(n=K))``), so
+    pinning (a) the tool-level trace bytes for the drills' own default
+    seeds and (b) the tool-args equivalence is sufficient: (a) breaks on
+    any RNG/draw-order change, (b) breaks if a tool's workload drifts
+    from the documented invocation."""
+    import hashlib
+
+    lg = _loadgen()
+
+    def digest(obj):
+        return hashlib.sha256(
+            json.dumps(obj, sort_keys=True).encode()).hexdigest()[:16]
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_drill", os.path.join(REPO, "tools", "chaos_drill.py"))
+    drill = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(drill)
+
+    # chaos_drill.standard_trace (quality gate fault_drill / bench
+    # resilience): trace AND fault plan, at the drill's default seed.
+    trace, plan = drill.standard_trace()
+    assert digest(trace) == "6e6282b1b8b0a390"
+    assert digest(plan.to_dict()) == "90d33cc61ce2c5d6"
+
+    # tools/soak.py's stream (run_soak defaults): 30s virtual horizon at
+    # 20 req/s, seed 0, the 0.5:1,off:1 gate mix.
+    soak = list(lg.generate_stream(
+        30000.0, mode="poisson", rate_per_s=20.0, seed=0, steps=4,
+        gate_mix=lg.parse_gate_mix("0.5:1,off:1")))
+    assert len(soak) == 608
+    assert digest(soak) == "14b4eb6b30c3d634"
+
+    # cache_parity_drill's zipf trace (quality gate cache_parity / bench
+    # serve.cache): the --zipf 1.1 repeat-heavy workload at its defaults.
+    zipf = lg.generate_trace(32, mode="poisson", rate_per_s=10.0, seed=13,
+                             steps=3, gate=0.5, zipf_s=1.1,
+                             zipf_universe=16)
+    assert digest(zipf) == "4c50f6ead3fe43e2"
+    # ...and the drill really runs exactly that workload (args drift pin).
+    import inspect
+
+    sig = inspect.signature(drill.cache_parity_drill)
+    assert sig.parameters["n"].default == 32
+    assert sig.parameters["seed"].default == 13
+    assert sig.parameters["steps"].default == 3
+    assert sig.parameters["zipf_s"].default == 1.1
+    assert sig.parameters["zipf_universe"].default == 16
+    assert sig.parameters["rate_per_s"].default == 10.0
+
+
 def test_validation_errors():
     lg = _loadgen()
     with pytest.raises(ValueError, match="n must be"):

@@ -1,0 +1,578 @@
+"""Content-addressed semantic caching (ISSUE 13): the ``content_key``
+derivation (completeness sweep + masked-field regression + normalization
+pins), the three cache layers' storage contracts (L1 byte-bounded
+memoization, L2 template-refusal/corrupt-entry silent-miss fallback, L3
+eviction + lazy-load), single-flight collapsing with real request
+lifecycles for leaders AND followers, the journal ``cache`` record's
+replay/snapshot fold, the dp=2 mesh leg, and the disabled-mode parity
+contract (semcache=None changes nothing — not a record byte, a journal
+line, or a metric family).
+
+Control-flow properties run against injected runners and a virtual timer
+(the test_slo idiom); the bitwise halves (value-only fields perturb
+images, mesh-cached serves match uncached) run real tiny-pipeline
+runners. The end-to-end zipf parity and insert-kill durability drills
+live in tools/chaos_drill.py, enforced by the quality gate's default-on
+``cache_parity`` leg.
+"""
+
+import json
+import os
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from p2p_tpu.serve import (
+    Cancel,
+    Journal,
+    MeshSpec,
+    Request,
+    SemCache,
+    prepare,
+    serve_forever,
+)
+from p2p_tpu.serve.journal import replay
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Content-key derivation: completeness sweep, regression, normalization
+# ---------------------------------------------------------------------------
+
+
+def test_content_key_sweep_both_directions(tiny_pipe):
+    """Every Request field, both directions (the jaxcheck completeness
+    idiom): output-determining fields perturb ``content_key`` — a miss
+    here is cache poisoning, wrong images served bitwise-confidently —
+    and scheduling metadata must not, or identical traffic splits across
+    cache lines (lost hits)."""
+    from p2p_tpu.analysis.compile_key import check_content_key
+
+    verdicts = check_content_key(tiny_pipe)
+    bad = [v.format() for v in verdicts if not v.ok]
+    assert not bad, "\n".join(bad)
+    # Every Request field got a verdict (schema growth cannot dodge it).
+    import dataclasses
+
+    assert {v.field for v in verdicts} == \
+        {f.name for f in dataclasses.fields(Request)}
+
+
+def test_content_key_sweep_catches_masked_and_superfluous_fields(tiny_pipe):
+    """The regression hook (the acceptance criterion for the checker):
+    masking ``seed`` out of the key under test must be caught as
+    poisoning for exactly the seed field, and smuggling ``request_id``
+    in must be caught as a lost-hit split for exactly that field."""
+    from p2p_tpu.analysis.compile_key import check_content_key
+
+    def masked(prep):
+        # The seed sits at a fixed slot of the content tuple; drop it.
+        return tuple(x for i, x in enumerate(prep.content_key) if i != 4)
+
+    verdicts = check_content_key(tiny_pipe, key_fn=masked,
+                                 fields=["seed", "prompt", "request_id"])
+    by = {v.field: v for v in verdicts}
+    assert not by["seed"].ok and "poisoning" in by["seed"].problem
+    assert by["prompt"].ok and by["request_id"].ok
+
+    def superfluous(prep):
+        return prep.content_key + (prep.request.request_id,)
+
+    verdicts = check_content_key(tiny_pipe, key_fn=superfluous,
+                                 fields=["request_id", "seed"])
+    by = {v.field: v for v in verdicts}
+    assert not by["request_id"].ok and "lost hits" in by["request_id"].problem
+    assert by["seed"].ok
+
+
+def test_content_key_refuses_unpartitioned_schema(tiny_pipe, monkeypatch):
+    """A new Request field must decide its cache identity before anything
+    can ride a cached serve: with the CONTENT/SCHEDULING partition no
+    longer covering the schema, ``content_key`` (hence ``prepare``)
+    refuses outright — and the analysis sweep's cross-check refuses the
+    divergence too."""
+    from p2p_tpu.analysis.compile_key import check_content_key
+    from p2p_tpu.serve import request as request_mod
+
+    monkeypatch.setattr(
+        request_mod, "CONTENT_FIELDS",
+        tuple(f for f in request_mod.CONTENT_FIELDS if f != "seed"))
+    req = Request(request_id="r", prompt="a cat", target="a dog", steps=4)
+    with pytest.raises(ValueError, match="partition"):
+        prepare(req, tiny_pipe)
+    with pytest.raises(ValueError, match="OUTPUT_DETERMINING disagrees"):
+        check_content_key(tiny_pipe, fields=["seed"])
+
+
+def test_content_key_normalizations(tiny_pipe):
+    """The key is the request's OUTPUT identity, not its spelling:
+    equivalent gate spellings share one cache line, a pure generation
+    normalizes away the edit knobs a missing ``target`` makes inert, and
+    a live edit keeps them."""
+    def ck(**kw):
+        d = dict(request_id="r", prompt="a cat riding a bike", steps=4,
+                 seed=7)
+        d.update(kw)
+        return prepare(Request.from_dict(d), tiny_pipe).content_key
+
+    # gate=0.5 at steps=4 resolves to step 2: identical trajectory,
+    # identical cache line — and scheduling metadata never splits it.
+    assert ck(gate=0.5) == ck(gate=2)
+    assert ck(gate=0.5) == ck(gate=2, priority=3, tenant="acme",
+                              tier="premium", deadline_ms=50.0,
+                              request_id="other")
+    assert ck(gate=0.5) != ck()                       # gated vs ungated
+    # Generation: mode/cross_steps shape nothing without a target.
+    assert ck(mode="refine") == ck(mode="replace")
+    assert ck(mode="replace", cross_steps=0.5) == ck(mode="replace")
+    # Edit: the same knobs are live.
+    assert ck(target="a dog riding a bike", mode="refine") != \
+        ck(target="a dog riding a bike", mode="replace")
+    assert ck(target="a dog riding a bike") != ck()
+
+
+def test_value_only_fields_perturb_images(tiny_pipe):
+    """The fields no jaxpr can see — seed, prompt, guidance,
+    negative_prompt change output *values* inside one compiled program —
+    really do determine the images (so their presence in the content key
+    is load-bearing, not decorative), and a repeated request is bitwise
+    stable (so serving a hit bitwise is sound)."""
+    variants = {
+        "base": {},
+        "seed": {"seed": 9},
+        "prompt": {"prompt": "a pig riding a bike"},
+        "guidance": {"guidance": 3.0},
+        "negative": {"negative_prompt": "blurry"},
+    }
+
+    def run(overrides):
+        d = dict(request_id="v", prompt="a cat riding a bike", steps=2,
+                 seed=7, arrival_ms=0.0)
+        d.update(overrides)
+        reqs = [Request.from_dict(d)]
+        recs = list(serve_forever(tiny_pipe, reqs, max_batch=1,
+                                  max_wait_ms=5.0, prewarm=reqs[:1]))
+        (ok,) = [r for r in recs if r["status"] == "ok"]
+        return np.asarray(ok["images"]).tobytes()
+
+    images = {name: run(ov) for name, ov in variants.items()}
+    assert run({}) == images["base"]          # repeat: bitwise stable
+    blobs = list(images.values())
+    assert len(set(blobs)) == len(blobs), \
+        "a value-only content field failed to perturb the output images"
+
+
+# ---------------------------------------------------------------------------
+# Layer storage contracts
+# ---------------------------------------------------------------------------
+
+
+def test_l1_memoizes_bitwise_and_bounds_bytes(tmp_path):
+    arr = np.arange(64, dtype=np.float32)          # 256 bytes
+    sc = SemCache(spill_dir=str(tmp_path), l1_bytes=600)
+    calls = []
+
+    def build(i):
+        def _b():
+            calls.append(i)
+            return arr + i
+        return _b
+
+    a = sc.l1_get_or_build(("m", "p0"), build(0))
+    assert sc.l1_get_or_build(("m", "p0"), build(0)) is a   # memoized
+    assert calls == [0]
+    assert sc.stats["l1"] == {"hits": 1, "misses": 1, "inserts": 1,
+                              "evictions": 0, "corrupt": 0}
+    # Third distinct entry blows the 600-byte budget: LRU evicts p0.
+    sc.l1_get_or_build(("m", "p1"), build(1))
+    sc.l1_get_or_build(("m", "p2"), build(2))
+    assert sc.stats["l1"]["evictions"] == 1
+    assert (sc.l1_get_or_build(("m", "p0"), build(0)) == arr).all()
+    assert calls == [0, 1, 2, 0]                   # p0 was rebuilt
+    # A disabled layer never stores, never hits, never counts.
+    off = SemCache(spill_dir=str(tmp_path / "off"), layers=("l2", "l3"))
+    off.l1_get_or_build(("m", "p0"), build(9))
+    off.l1_get_or_build(("m", "p0"), build(9))
+    assert off.stats["l1"] == {"hits": 0, "misses": 0, "inserts": 0,
+                               "evictions": 0, "corrupt": 0}
+    with pytest.raises(ValueError, match="unknown cache layer"):
+        SemCache(layers=("l1", "l9"))
+
+
+def test_l2_template_refusal_and_corrupt_entry_fallback(tiny_pipe,
+                                                        tmp_path):
+    """A wrong-shaped carry must never reach a compiled program, and a
+    bad cache entry must never fail a request: both the template refusal
+    (an entry spilled for a different request shape) and a corrupt spill
+    degrade to a silent miss + recompute, dropping the entry."""
+    from p2p_tpu.serve.handoff import carry_template
+
+    def prep(**kw):
+        d = dict(request_id="s", prompt="a cat", target="a dog", steps=4,
+                 gate=2)
+        d.update(kw)
+        return prepare(Request.from_dict(
+            {k: v for k, v in d.items() if v is not None}), tiny_pipe)
+
+    p4 = prep()
+    # A generation's hand-off unit has one lane where the edit has two:
+    # a genuinely different leaf shape, the refusal case.
+    pgen = prep(request_id="g", target=None)
+    sc = SemCache(spill_dir=str(tmp_path))
+    ck4 = sc.digest(p4.content_key)
+    # The zero-valued template is itself a well-formed hand-off unit.
+    sc.l2_put(ck4, carry_template(tiny_pipe, p4))
+    assert sc.l2_has(ck4)
+    got = sc.l2_get(ck4, carry_template(tiny_pipe, p4))
+    assert got is not None and sc.stats["l2"]["hits"] == 1
+    # Template refusal: validating the same spill against a different
+    # request's shapes is a silent miss, and the entry is dropped.
+    sc.l2_put(ck4, carry_template(tiny_pipe, p4))   # re-inserted no-op
+    assert sc.l2_get(ck4, carry_template(tiny_pipe, pgen)) is None
+    assert sc.stats["l2"]["corrupt"] == 1
+    assert not sc.l2_has(ck4)
+    # Corrupt spill on disk: same contract.
+    sc.l2_put(ck4, carry_template(tiny_pipe, p4))
+    with open(sc._l2_path(ck4), "wb") as f:
+        f.write(b"not an npz")
+    assert sc.l2_get(ck4, carry_template(tiny_pipe, p4)) is None
+    assert sc.stats["l2"]["corrupt"] == 2
+    assert not os.path.exists(sc._l2_path(ck4))     # dropped, disk too
+    # Entry-count LRU bound: the oldest spill (file included) goes.
+    tight = SemCache(spill_dir=str(tmp_path / "tight"), l2_entries=1)
+    tight.l2_put("a" * 32, carry_template(tiny_pipe, p4))
+    tight.l2_put("b" * 32, carry_template(tiny_pipe, p4))
+    assert not tight.l2_has("a" * 32) and tight.l2_has("b" * 32)
+    assert tight.stats["l2"]["evictions"] == 1
+    # shed_l2: the degradation ladder's cheapest rung clears everything.
+    assert tight.shed_l2() == 1
+    assert not os.listdir(tight.spill_dir)
+
+
+def test_l3_eviction_lazy_load_and_corrupt_spill(tmp_path):
+    img = np.full((1, 4, 4, 3), 7, np.uint8)       # 48 bytes
+    sc = SemCache(spill_dir=str(tmp_path), l3_bytes=100)
+    p = sc.l3_put("k1", img)
+    assert p and os.path.exists(p)                 # durable spill
+    assert (sc.l3_get("k1") == img).all()
+    # Third entry blows the 2-entry budget: LRU evicts k1, spill deleted.
+    sc.l3_put("k2", img + 1)
+    sc.l3_put("k3", img + 2)
+    assert sc.stats["l3"]["evictions"] == 1
+    assert sc.l3_get("k1") is None and not os.path.exists(p)
+    assert sc.stats["l3"]["misses"] == 1
+    # Re-inserting an existing key is a no-op (no journal re-record).
+    assert sc.l3_put("k2", img + 1) is None
+    # Seeded (journal-replayed) entries load lazily off the spill; a
+    # corrupt or missing spill is a silent miss + drop, never a fault.
+    fresh = SemCache(spill_dir=str(tmp_path / "fresh"))
+    good = os.path.join(fresh.spill_dir, "r-good.npz")
+    with open(good, "wb") as f:
+        np.savez(f, images=img)
+    bad = os.path.join(fresh.spill_dir, "r-bad.npz")
+    with open(bad, "wb") as f:
+        f.write(b"garbage")
+    orphan = os.path.join(fresh.spill_dir, "r-orphan.npz")
+    with open(orphan, "wb") as f:
+        np.savez(f, images=img)
+    assert fresh.seed({"kg": {"path": good}, "kb": {"path": bad},
+                       "missing": {"path": good + ".nope"}}) == 2
+    assert not os.path.exists(orphan)              # unreferenced: swept
+    assert (fresh.l3_get("kg") == img).all()
+    assert fresh.l3_get("kb") is None
+    assert fresh.stats["l3"]["corrupt"] == 1
+    # Seeded lazy loads charge the same byte budget as inserts: a
+    # restart with many journaled entries must not grow residency
+    # unbounded on a hit-only workload.
+    tight = SemCache(spill_dir=str(tmp_path / "tight"), l3_bytes=100)
+    entries = {}
+    for i, k in enumerate(("ka", "kb2", "kc")):
+        path = os.path.join(tight.spill_dir, f"r-{k}.npz")
+        with open(path, "wb") as f:
+            np.savez(f, images=img + i)
+        entries[k] = {"path": path}
+    assert tight.seed(entries) == 3
+    for k in ("ka", "kb2", "kc"):
+        assert tight.l3_get(k) is not None
+    assert tight.stats["l3"]["evictions"] >= 1
+    assert tight.layer_stats()["l3"]["bytes"] <= 100
+
+
+# ---------------------------------------------------------------------------
+# Engine: single-flight collapsing, follower lifecycles (fake runners)
+# ---------------------------------------------------------------------------
+
+
+class VirtualTimer:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt_s):
+        self.t += dt_s
+
+
+class FakeRunner:
+    def __init__(self, compile_key, bucket, timer, run_s=0.1, warm_s=0.5):
+        self.bucket = bucket
+        self.timer, self.run_s, self.warm_s = timer, run_s, warm_s
+
+    def warm(self, entries):
+        self.timer.advance(self.warm_s)
+
+    def __call__(self, entries, guidance):
+        self.timer.advance(self.run_s)
+        g = len(entries[0].request.prompts)
+        # Seed-dependent values so distinct content really is distinct.
+        s = entries[0].request.seed % 251
+        return np.full((self.bucket, g, 2, 2, 3), s, np.uint8)
+
+
+def _fake_serve(tiny_pipe, reqs, sc, timer=None, **kw):
+    timer = timer or VirtualTimer()
+
+    def factory(compile_key, bucket):
+        return FakeRunner(compile_key, bucket, timer)
+
+    return list(serve_forever(tiny_pipe, reqs, runner_factory=factory,
+                              timer=timer, semcache=sc, **kw))
+
+
+def _req(rid, arrival=0.0, **kw):
+    d = dict(request_id=rid, prompt="a cat riding a bike",
+             target="a dog riding a bike", steps=4, seed=11,
+             arrival_ms=arrival)
+    d.update(kw)
+    return Request.from_dict(d)
+
+
+def _by_id(recs):
+    return {r["request_id"]: r for r in recs if r.get("request_id")}
+
+
+def test_single_flight_collapse_and_l3_hits(tiny_pipe, tmp_path):
+    """Identical in-flight requests ride one leader — each follower still
+    gets its OWN terminal record and flight trace — and a duplicate
+    arriving after the leader resolved is a plain L3 exact hit. Distinct
+    content is never collapsed."""
+    from p2p_tpu.obs.flight import FlightTracer
+
+    sc = SemCache(spill_dir=str(tmp_path))
+    flight = FlightTracer()
+    reqs = [_req("lead", 0.0), _req("f1", 1.0), _req("f2", 2.0),
+            _req("other", 3.0, seed=9),            # distinct content
+            _req("late", 5000.0)]                  # arrives post-terminal
+    recs = _fake_serve(tiny_pipe, reqs, sc, max_batch=4, max_wait_ms=10.0,
+                       flight=flight)
+    by = _by_id(recs)
+    assert {r["request_id"]: r["status"] for r in recs
+            if r.get("request_id")} == {
+        "lead": "ok", "f1": "ok", "f2": "ok", "other": "ok", "late": "ok"}
+    # The leader computed; followers carry the collapsed marker and the
+    # leader's bitwise images; the late duplicate is an exact hit.
+    assert "cache" not in by["lead"] and "cache" not in by["other"]
+    for rid in ("f1", "f2"):
+        assert by[rid]["cache"] == {"layer": "l3", "collapsed": True}
+        assert np.array_equal(np.asarray(by[rid]["images"]),
+                              np.asarray(by["lead"]["images"]))
+    assert by["late"]["cache"] == {"layer": "l3"}
+    assert by["late"]["total_ms"] == pytest.approx(
+        by["late"]["queue_wait_ms"])               # no compute at all
+    summary = recs[-1]
+    assert summary["semcache"]["served"] == {"l2": 0, "l3": 1,
+                                             "collapsed": 2}
+    assert summary["semcache"]["served_from_cache"] == 3
+    assert summary["semcache"]["layers"]["l3"]["inserts"] == 2
+    # Every cached serve's flight trace owns its whole lifetime as one
+    # cache_hit segment — no compute stages to attribute.
+    for rid in ("f1", "f2", "late"):
+        (rec,) = [r for r in flight.records if r["request_id"] == rid]
+        assert "cache_hit" in {s["stage"] for s in rec["segments"]}
+        assert not {"compile", "run"} & {s["stage"]
+                                         for s in rec["segments"]}
+        assert rec.get("attribution_ok", True), rec
+
+
+def test_follower_cancel_and_deadline_checked_at_emission(tiny_pipe,
+                                                          tmp_path):
+    """A follower is a real request with its own lifecycle, not an alias
+    of its leader: cancellation and deadline expiry are checked when its
+    terminal is emitted, exactly like a dispatching batch."""
+    sc = SemCache(spill_dir=str(tmp_path))
+    # The in-band warm (no prewarm) burns 500ms of virtual time under the
+    # leader's batch, so doomed's 200ms deadline passes while collapsed.
+    reqs = [_req("lead", 0.0), _req("doomed", 1.0, deadline_ms=200.0),
+            _req("dropped", 2.0), Cancel("dropped"), _req("kept", 3.0)]
+    recs = _fake_serve(tiny_pipe, reqs, sc, max_batch=4, max_wait_ms=10.0)
+    by = _by_id(recs)
+    assert by["lead"]["status"] == "ok"
+    assert by["kept"]["status"] == "ok"
+    assert by["kept"]["cache"] == {"layer": "l3", "collapsed": True}
+    assert by["doomed"]["status"] == "expired"
+    assert "collapsed" in by["doomed"]["reason"]
+    assert by["dropped"]["status"] == "cancelled"
+    assert recs[-1]["semcache"]["served"]["collapsed"] == 1
+    assert recs[-1]["counts"]["ok"] == 2
+
+
+def test_leader_cancel_promotes_follower(tiny_pipe, tmp_path):
+    """A leader's cancellation must never starve its followers: the first
+    follower is promoted into a fresh leader re-entering the pipeline,
+    and later followers ride the promoted one."""
+    sc = SemCache(spill_dir=str(tmp_path))
+    reqs = [_req("lead", 0.0), _req("f1", 1.0), _req("f2", 2.0),
+            Cancel("lead")]
+    recs = _fake_serve(tiny_pipe, reqs, sc, max_batch=4, max_wait_ms=10.0)
+    by = _by_id(recs)
+    assert by["lead"]["status"] == "cancelled"
+    assert by["f1"]["status"] == "ok"
+    assert "cache" not in by["f1"]                  # promoted: it computed
+    assert by["f2"]["status"] == "ok"
+    assert by["f2"]["cache"] == {"layer": "l3", "collapsed": True}
+    assert np.array_equal(np.asarray(by["f2"]["images"]),
+                          np.asarray(by["f1"]["images"]))
+
+
+def test_disabled_mode_byte_parity(tiny_pipe, tmp_path):
+    """semcache=None changes nothing: no semcache summary block, no
+    serve_semcache metric family, no journal ``cache`` record — and the
+    journal + record stream are byte-stable across reruns. Families and
+    blocks appear only under an active SemCache (the slo/mesh/chaos
+    disabled-mode discipline)."""
+    from p2p_tpu.obs import metrics as obs_metrics
+
+    reqs = [_req(f"r{i}", float(i)) for i in range(4)]
+
+    def run(path, sc):
+        j = Journal(path)
+        recs = _fake_serve(tiny_pipe, [
+            Request.from_dict(r.to_dict()) for r in reqs], sc,
+            journal=j, max_batch=4, max_wait_ms=10.0)
+        j.close()
+        return recs
+
+    obs_metrics.registry().reset()
+    a = run(str(tmp_path / "a.wal"), None)
+    snap = obs_metrics.registry().snapshot()
+    b = run(str(tmp_path / "b.wal"), None)
+    strip = lambda recs: json.dumps(
+        [{k: v for k, v in r.items() if k != "images"} for r in recs],
+        sort_keys=True)
+    assert strip(a) == strip(b)
+    assert "semcache" not in a[-1]
+    assert not any(r.get("cache") or r.get("stage_phase") == "cached"
+                   for r in a)
+    assert open(tmp_path / "a.wal", "rb").read() == \
+        open(tmp_path / "b.wal", "rb").read()
+    assert "cache" not in {json.loads(l)["type"]
+                           for l in open(tmp_path / "a.wal") if l.strip()}
+    # Families registered by OTHER tests' SemCache instances survive the
+    # in-process registry reset, but a cache-less run must never touch
+    # them: every semcache sample stays exactly zero.
+    assert not [
+        (k, s) for k in snap if "semcache" in k
+        for s in snap[k]["samples"] if s.get("value")]
+    # With the cache on: the families, the summary block, and (for a
+    # repeat-heavy trace) the journal cache record all appear.
+    dup = [_req("d0", 0.0), _req("d1", 5000.0)]
+    c = run(str(tmp_path / "c.wal"),
+            SemCache(spill_dir=str(tmp_path / "spill")))
+    c = _fake_serve(tiny_pipe, dup, SemCache(
+        spill_dir=str(tmp_path / "spill2")),
+        journal=Journal(str(tmp_path / "d.wal")),
+        max_batch=4, max_wait_ms=10.0)
+    assert "semcache" in c[-1]
+    snap2 = obs_metrics.registry().snapshot()
+    assert any("serve_semcache_events_total" in k for k in snap2)
+    assert any("serve_semcache_served_total" in k for k in snap2)
+    assert "cache" in {json.loads(l)["type"]
+                       for l in open(tmp_path / "d.wal") if l.strip()}
+
+
+# ---------------------------------------------------------------------------
+# Journal: cache records across replay, snapshot, and reseed
+# ---------------------------------------------------------------------------
+
+
+def test_journal_cache_records_fold_replay_and_snapshot(tmp_path):
+    img = np.full((1, 2, 2, 3), 3, np.uint8)
+    spill = str(tmp_path / "r-abc.npz")
+    with open(spill, "wb") as f:
+        np.savez(f, images=img)
+    gone = str(tmp_path / "r-gone.npz")
+
+    wal = str(tmp_path / "cache.wal")
+    j = Journal(wal)
+    j.admitted({"request_id": "lead", "prompt": "a cat", "steps": 4}, 0.0)
+    j.cache_insert("abc", "lead", spill, 1.0)
+    j.cache_insert("gone", "lead", gone, 1.5)      # spill later evicted
+    j.terminal("lead", "ok", 2.0)
+    j.sync()
+    state = replay(wal)
+    assert set(state.cache_entries) == {"abc", "gone"}
+    assert state.cache_entries["abc"]["path"] == spill
+    assert state.skipped_corrupt == 0
+    # A torn/corrupt cache record (no key) is counted, never folded.
+    j._append({"type": "cache", "path": spill})
+    j.sync()
+    assert replay(wal).skipped_corrupt == 1
+    # Snapshot fold: only entries whose spill still exists survive (an
+    # evicted spill's stale pointer is dropped, not resurrected), and a
+    # replay off the compacted journal still seeds the cache.
+    j.compact()
+    j.close()
+    state2 = replay(wal)
+    assert state2.snapshot_loaded
+    assert set(state2.cache_entries) == {"abc"}
+    sc = SemCache(spill_dir=str(tmp_path))
+    assert sc.seed(state2.cache_entries) == 1
+    assert (sc.l3_get("abc") == img).all()
+
+
+def test_cacheless_snapshot_has_no_cache_key(tmp_path):
+    """Pre-cache snapshot schema parity: a run that never inserted keeps
+    the snapshot byte-schema cache-less (no ``cache`` key at all)."""
+    wal = str(tmp_path / "plain.wal")
+    j = Journal(wal)
+    j.admitted({"request_id": "r0", "prompt": "a cat", "steps": 4}, 0.0)
+    j.terminal("r0", "ok", 1.0)
+    j.compact()
+    j.close()
+    snap = json.load(open(wal + ".snapshot"))
+    assert "cache" not in snap
+    assert replay(wal).cache_entries == {}
+
+
+# ---------------------------------------------------------------------------
+# Mesh leg: the cache above a dp-sharded engine, bitwise
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_mesh_dp2_cached_serves_bitwise(tiny_pipe, tmp_path):
+    """The cache sits above the mesh engine: a dp=2 repeat-heavy trace
+    served cached is bitwise-identical to the uncached mesh run, with a
+    real fraction served from cache."""
+    reqs = [_req("m0", 0.0), _req("m1", 1.0, seed=9),
+            _req("m0b", 4000.0), _req("m1b", 4001.0, seed=9)]
+
+    def run(sc):
+        return list(serve_forever(
+            tiny_pipe, [Request.from_dict(r.to_dict()) for r in reqs],
+            max_batch=2, max_wait_ms=10.0, prewarm=[reqs[0]],
+            mesh=MeshSpec(dp=2), semcache=sc))
+
+    clean = _by_id(run(None))
+    cached_recs = run(SemCache(spill_dir=str(tmp_path / "mesh")))
+    cached = _by_id(cached_recs)
+    assert {r: cached[r]["status"] for r in cached} == \
+        {r: "ok" for r in cached}
+    for rid in ("m0", "m1", "m0b", "m1b"):
+        assert np.array_equal(np.asarray(cached[rid]["images"]),
+                              np.asarray(clean[rid]["images"])), rid
+    assert cached_recs[-1]["semcache"]["served_from_cache"] >= 2
+    assert cached_recs[-1]["mesh"]["dp"] == 2

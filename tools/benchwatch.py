@@ -53,6 +53,7 @@ HEADLINE_KEYS: Tuple[Tuple[str, str, str], ...] = (
     ("serve.mesh.imgs_per_s_per_device", "img/s/device", "higher"),
     ("serve.mesh.scaling_ratio", "x", "higher"),
     ("serve.slo.premium_p99_ratio", "x", "lower"),
+    ("serve.cache.amplification", "x", "higher"),
     ("obs.overhead_pct", "%", "lower"),
     ("nullinv_s_per_image", "s/image", "lower"),
 )

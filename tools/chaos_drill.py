@@ -764,6 +764,204 @@ def preempt_kill_drill(pipe, journal_path, *, steps=3,
     }
 
 
+def cache_parity_drill(pipe, *, n=32, seed=13, steps=3, zipf_s=1.1,
+                       zipf_universe=16, gate=0.5, rate_per_s=10.0,
+                       l3_bytes=None, serve_kw=None) -> dict:
+    """The semantic-cache parity drill (ISSUE 13): a seeded ``--zipf``
+    repeat-heavy trace served twice — uncached, then through a fresh
+    :class:`~p2p_tpu.serve.SemCache` — must produce **bitwise-identical
+    ok outputs** with a real fraction of the traffic served from cache.
+    The gate's default-on ``cache_parity`` leg and the bench
+    ``serve.cache`` sub-record both read the returned facts.
+
+    Every request is gated (``gate=0.5``) so all three layers are live;
+    ``rate_per_s`` spaces virtual arrivals so repeats land both while
+    their leader is still in flight (single-flight collapse) and after
+    it completed (real L3/L2 lookups) — at a dense rate everything
+    collapses and the stores are never read; ``l3_bytes`` defaults to
+    two entries' worth of images, so the L3
+    budget actually evicts under the zipf universe and repeats of evicted
+    content fall through to the L2 prefix store — the drill exercises
+    hit, miss, eviction AND the L2 fallback on one deterministic trace.
+    The headline number is ``amplification``: images/sec cached over
+    images/sec uncached at the identical offered trace (equal
+    device-seconds of demand) — the traffic the cache serves without
+    computing it."""
+    import importlib.util
+
+    import numpy as np
+
+    from p2p_tpu.serve import Request, SemCache, serve_forever
+
+    spec = importlib.util.spec_from_file_location(
+        "p2p_loadgen", os.path.join(_REPO, "tools", "loadgen.py"))
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+
+    trace = loadgen.generate_trace(
+        n, mode="poisson", rate_per_s=rate_per_s, seed=seed, steps=steps,
+        gate=gate, zipf_s=zipf_s, zipf_universe=zipf_universe)
+    kw = dict(max_batch=4, max_wait_ms=20.0, queue_cap=256,
+              phase2_max_batch=4)
+    kw.update(serve_kw or {})
+    if "prewarm" not in kw:
+        kw["prewarm"] = _prewarm_reps(pipe, trace)
+
+    def run(semcache):
+        return list(serve_forever(pipe,
+                                  [Request.from_dict(d) for d in trace],
+                                  semcache=semcache, **kw))
+
+    run(None)                                   # warm programs unmeasured
+    clean = run(None)
+    clean_by_id = check_exactly_once(trace, clean, "uncached run")
+    if l3_bytes is None:
+        # Two entries' worth: the zipf universe then forces L3 evictions
+        # and L2 fallbacks on the same trace.
+        sample = next(r["images"] for r in clean if r["status"] == "ok")
+        l3_bytes = 2 * int(np.asarray(sample).nbytes)
+    sc = SemCache(spill_dir=os.path.join(
+        tempfile.mkdtemp(prefix="p2p-semcache-"), "spill"),
+        l3_bytes=l3_bytes)
+    cached = run(sc)
+    cached_by_id = check_exactly_once(trace, cached, "cached run")
+    bitwise = check_bitwise_vs_clean(clean_by_id, cached_by_id)
+    if bitwise != sum(1 for r in clean_by_id.values()
+                      if r["status"] == "ok"):
+        raise DrillFailure(
+            f"cache parity: cached run served {bitwise} ok vs the "
+            f"uncached run's — a cached serve dropped or degraded traffic")
+
+    block = cached[-1]["semcache"]
+    served = block["served_from_cache"]
+    stats = block["layers"]
+
+    def hit_rate(layer):
+        s = stats[layer]
+        return round(s["hits"] / max(s["hits"] + s["misses"], 1), 4)
+
+    amp = clean[-1]["makespan_ms"] / max(cached[-1]["makespan_ms"], 1e-9)
+    return {
+        "n_requests": n,
+        "zipf_s": zipf_s,
+        "served_from_cache": served,
+        "served_from_cache_fraction": round(served / n, 4),
+        "l1_hits": stats["l1"]["hits"],
+        "l2_hits": stats["l2"]["hits"],
+        "l3_hits": stats["l3"]["hits"],
+        "l1_hit_rate": hit_rate("l1"),
+        "l2_hit_rate": hit_rate("l2"),
+        "l3_hit_rate": hit_rate("l3"),
+        "l3_evictions": stats["l3"]["evictions"],
+        "collapsed": block["served"]["collapsed"],
+        "uncached_makespan_ms": round(clean[-1]["makespan_ms"], 1),
+        "cached_makespan_ms": round(cached[-1]["makespan_ms"], 1),
+        "amplification": round(amp, 3),
+    }
+
+
+def cache_insert_kill_drill(pipe, journal_path, *, steps=3) -> dict:
+    """The cache durability drill (ISSUE 13): a chaos
+    ``kill_after_cache_insert`` dies between the leader's L3 insert (spill
+    + journaled ``cache`` record, both durable) and its terminal fsync.
+    The restart must reseed the cache off the journal and serve the
+    still-pending leader AND its followers from the durable insert —
+    exactly-once across the union of both runs, outputs bitwise-identical
+    to the uncached run, zero corrupt records."""
+    import numpy as np
+
+    from p2p_tpu.serve import (FaultPlan, Journal, Request, SemCache,
+                               SimulatedKill, serve_forever)
+    from p2p_tpu.serve.chaos import KILL_AFTER_CACHE_INSERT
+
+    prompts = ("a cat riding a bike", "a dog riding a bike")
+
+    def req(rid, arrival, seed=42):
+        return {"request_id": rid, "prompt": prompts[0],
+                "target": prompts[1], "mode": "replace", "steps": steps,
+                "seed": seed, "gate": 0.5, "arrival_ms": arrival}
+
+    leader = "ck-leader"
+    trace = [req(leader, 0.0), req("ck-f1", 1.0), req("ck-f2", 2.0),
+             req("ck-distinct", 3.0, seed=9)]
+    kw = dict(max_batch=4, max_wait_ms=20.0, queue_cap=64,
+              phase2_max_batch=4, prewarm=_prewarm_reps(pipe, trace))
+
+    def to_reqs():
+        return [Request.from_dict(d) for d in trace]
+
+    clean = list(serve_forever(pipe, to_reqs(), **kw))
+    clean_by_id = check_exactly_once(trace, clean, "uncached run")
+
+    workdir = os.path.dirname(journal_path)
+    if os.path.exists(journal_path):
+        os.remove(journal_path)
+    plan = FaultPlan(by_request={leader: KILL_AFTER_CACHE_INSERT})
+    journal = Journal(journal_path)
+    sc = SemCache(spill_dir=os.path.join(workdir, "semcache"))
+    first: list = []
+    killed = False
+    gen = serve_forever(pipe, to_reqs(), journal=journal, chaos=plan,
+                        semcache=sc, **kw)
+    try:
+        for rec in first_iter(gen, first):
+            pass
+    except SimulatedKill:
+        killed = True
+        journal._f.close()   # simulated death: no clean close
+    if not killed:
+        raise DrillFailure("kill_after_cache_insert never fired — the "
+                           "leader's L3 insert was never reached")
+
+    journal2 = Journal(journal_path)
+    if not journal2.replay_state.cache_entries:
+        raise DrillFailure("the journaled cache record did not fold into "
+                           "replay — the restart would recompute what the "
+                           "durable insert already holds")
+    sc2 = SemCache(spill_dir=os.path.join(workdir, "semcache"))
+    second = list(serve_forever(pipe, to_reqs(), journal=journal2,
+                                semcache=sc2, **kw))
+    journal2.close()
+
+    seen: dict = {}
+    run2 = {r["request_id"]: r for r in _terminal_records(second)}
+    for rec in _terminal_records(first):
+        rid = rec["request_id"]
+        if rid in run2 and "rejected" not in (rec["status"],
+                                              run2[rid]["status"]):
+            raise DrillFailure(
+                f"kill_after_cache_insert: request {rid!r} reached a "
+                f"terminal state in both runs ({rec['status']!r}, then "
+                f"{run2[rid]['status']!r})")
+        seen.setdefault(rid, rec)
+    for rid, rec in run2.items():
+        seen.setdefault(rid, rec)
+    ids = [r["request_id"] for r in trace]
+    missing = [rid for rid in ids if rid not in seen]
+    if missing:
+        raise DrillFailure(f"kill_after_cache_insert: {len(missing)} "
+                           f"request(s) lost across the kill: {missing}")
+    bitwise = check_bitwise_vs_clean(clean_by_id, seen)
+    summary2 = second[-1]
+    served = summary2.get("semcache", {}).get("served_from_cache", 0)
+    if served < 1:
+        raise DrillFailure("the restart recomputed everything — the "
+                           "durable cache insert served nothing")
+    followers_ok = sum(
+        1 for rid in ("ck-f1", "ck-f2")
+        if seen.get(rid, {}).get("status") == "ok"
+        and np.array_equal(np.asarray(seen[rid]["images"]),
+                           np.asarray(clean_by_id[rid]["images"])))
+    return {
+        "n_requests": len(ids),
+        "killed": killed,
+        "bitwise_compared": bitwise,
+        "followers_bitwise": followers_ok,
+        "restart_served_from_cache": served,
+        "replay_skipped_corrupt": journal2.replay_state.skipped_corrupt,
+    }
+
+
 def first_iter(gen, sink):
     """Iterate ``gen`` appending into ``sink`` — keeps the try/except at
     the call site tight while the kill can fire mid-iteration."""
@@ -821,6 +1019,18 @@ def main(argv=None) -> int:
                          "gated request's carry then dies; the restart "
                          "must resume it off the spill exactly-once with "
                          "bitwise-identical output")
+    ap.add_argument("--cache-parity", action="store_true",
+                    help="also run the semantic-cache parity drill "
+                         "(ISSUE 13): a seeded --zipf repeat-heavy trace "
+                         "served cached vs uncached must be bitwise-"
+                         "identical with a real served-from-cache "
+                         "fraction (L3 evictions + L2 fallback included)")
+    ap.add_argument("--cache-kill", action="store_true",
+                    help="also run the cache durability drill (ISSUE 13): "
+                         "chaos kill_after_cache_insert dies between the "
+                         "leader's L3 insert and its terminal fsync; the "
+                         "restart must serve leader+followers off the "
+                         "journaled insert exactly-once, bitwise")
     ap.add_argument("--warmup", action="store_true",
                     help="one unmeasured clean pass first, so the p95 "
                          "delta is retry cost, not compile noise")
@@ -861,6 +1071,12 @@ def main(argv=None) -> int:
             jpath = args.journal or os.path.join(
                 tempfile.mkdtemp(prefix="p2p-preempt-"), "preempt.wal")
             result["preempt_kill"] = preempt_kill_drill(pipe, jpath)
+        if args.cache_parity:
+            result["cache"] = cache_parity_drill(pipe)
+        if args.cache_kill:
+            jpath = args.journal or os.path.join(
+                tempfile.mkdtemp(prefix="p2p-cachekill-"), "cache.wal")
+            result["cache_kill"] = cache_insert_kill_drill(pipe, jpath)
     except DrillFailure as e:
         print(f"DRILL FAILED: {e}", file=sys.stderr)
         return 1

@@ -158,7 +158,8 @@ def main(argv=None) -> int:
             paths, baseline_path=baseline_path)
         oks = [report["ast"]["summary"]["new"] == 0]
         if "contracts" in report:
-            oks += [report["contracts"]["ok"], report["compile_key"]["ok"]]
+            oks += [report["contracts"]["ok"], report["compile_key"]["ok"],
+                    report["content_key"]["ok"]]
         if "collectives" in report:
             oks.append(report["collectives"]["ok"])
         report["ok"] = all(oks)

@@ -24,7 +24,12 @@ the serve layer's phase hand-off and mixed-phase packing; the default
 pre-gate-mix traces. ``--tenant-mix``/``--tier-mix`` (ISSUE 12) draw the
 SLO scheduling fields (``tenant``, ``tier``) per request the same way —
 each mix on its OWN derived RNG stream, so adding or dropping any mix
-leaves arrivals, seeds and the other mixes byte-identical.
+leaves arrivals, seeds and the other mixes byte-identical. ``--zipf S``
+(ISSUE 13) draws each request's *identity* (prompt pair + seed — its
+semantic-cache content) from a Zipf(S) rank distribution over
+``--zipf-universe`` identities on the same separate-stream discipline, so
+popular requests repeat the way real traffic does while arrivals and
+deadlines stay byte-identical to the non-zipf trace.
 
     python tools/loadgen.py --n 48 --mode poisson --rate 20 --seed 0 \
         --steps 4 --out demo.jsonl
@@ -134,6 +139,8 @@ def generate_stream(
     gate_mix: Optional[List[tuple]] = None,
     tenant_mix: Optional[List[tuple]] = None,
     tier_mix: Optional[List[tuple]] = None,
+    zipf_s: Optional[float] = None,
+    zipf_universe: int = 32,
 ):
     """Yield request dicts in arrival order until ``arrival_ms`` would
     exceed ``duration_ms`` (and/or ``n`` requests have been produced; both
@@ -150,7 +157,18 @@ def generate_stream(
     stream, so adding (or dropping) one mix never perturbs arrivals,
     seeds, or another mix's draws — a tenant/tier-mixed trace is
     byte-identical to the mix-less trace everywhere but its own fields
-    (the ``--gate-mix`` discipline)."""
+    (the ``--gate-mix`` discipline).
+
+    ``zipf_s`` (ISSUE 13) switches popularity on: each request's
+    *identity* — its (prompt pair, seed), i.e. its semantic-cache content
+    — is drawn from a Zipf(s) rank distribution over ``zipf_universe``
+    distinct identities, so popular requests repeat the way real traffic
+    does and the serve layer's content-addressed cache has something to
+    hit. The rank draws (and the fixed identity table) ride their OWN
+    derived RNG streams and the main stream's per-request seed draw still
+    happens (discarded), so arrivals, deadlines and every other mix stay
+    byte-identical to the non-zipf trace — the ``--gate-mix``
+    discipline."""
     import numpy as np
 
     if mode not in ("poisson", "burst"):
@@ -159,6 +177,10 @@ def generate_stream(
         raise ValueError(f"rate_per_s must be positive, got {rate_per_s}")
     if duration_ms is not None and duration_ms < 0:
         raise ValueError(f"duration_ms must be >= 0, got {duration_ms}")
+    if zipf_s is not None and zipf_s <= 0:
+        raise ValueError(f"zipf s must be positive, got {zipf_s}")
+    if zipf_universe < 1:
+        raise ValueError(f"zipf universe must be >= 1, got {zipf_universe}")
 
     def _mix_drawer(mix, salt):
         # A separate derived stream per mix (the with_cancels idiom):
@@ -179,6 +201,23 @@ def generate_stream(
                    if tenant_mix is not None else None)
     draw_tier = (_mix_drawer(tier_mix, 0x3C11E7)
                  if tier_mix is not None else None)
+    draw_rank = None
+    if zipf_s is not None:
+        # Identity table: a FIXED zipf_universe of draws up front on its
+        # own derived stream (independent of n/duration — the prefix-
+        # stability invariant), then one rank draw per request on a
+        # second derived stream. p(rank r) ∝ (r+1)^-s.
+        id_rng = np.random.RandomState(seed ^ 0x21BF52)
+        id_seeds = [int(id_rng.randint(0, 2 ** 31 - 1))
+                    for _ in range(zipf_universe)]
+        w = np.array([(r + 1.0) ** (-zipf_s) for r in range(zipf_universe)])
+        zcuts = np.cumsum(w / w.sum())
+        zipf_rng = np.random.RandomState(seed ^ 0x21BF53)
+
+        def draw_rank():
+            x = zipf_rng.random_sample()
+            return (int(np.searchsorted(zcuts, x, side="right"))
+                    if x < zcuts[-1] else zipf_universe - 1)
     rng = np.random.RandomState(seed)
     at = 0.0
     i = 0
@@ -197,6 +236,14 @@ def generate_stream(
         if duration_ms is not None and at > duration_ms:
             return
         src, tgt = _CORPUS[i % len(_CORPUS)]
+        # The per-request seed draw ALWAYS happens (uniform RNG
+        # consumption — arrivals stay byte-identical under --zipf, whose
+        # rank draw then overrides the request's identity).
+        seed_draw = int(rng.randint(0, 2 ** 31 - 1))
+        if draw_rank is not None:
+            rank = draw_rank()
+            src, tgt = _CORPUS[rank % len(_CORPUS)]
+            seed_draw = id_seeds[rank]
         req = {
             "request_id": f"{mode}-{seed:04d}-{i:04d}",
             "prompt": src,
@@ -204,7 +251,7 @@ def generate_stream(
             "mode": "replace",
             "steps": steps + (i % distinct_keys if distinct_keys > 1 else 0),
             "scheduler": scheduler,
-            "seed": int(rng.randint(0, 2 ** 31 - 1)),
+            "seed": seed_draw,
             "arrival_ms": round(float(at), 3),
         }
         req_gate = draw_gate() if draw_gate is not None else gate
@@ -239,6 +286,8 @@ def generate_trace(
     gate_mix: Optional[List[tuple]] = None,
     tenant_mix: Optional[List[tuple]] = None,
     tier_mix: Optional[List[tuple]] = None,
+    zipf_s: Optional[float] = None,
+    zipf_universe: int = 32,
 ) -> List[dict]:
     """Build ``n`` request dicts sorted by ``arrival_ms`` (deterministic in
     ``seed``) — the finite materialized form of :func:`generate_stream`,
@@ -256,7 +305,8 @@ def generate_trace(
         scheduler=scheduler, burst_size=burst_size,
         burst_gap_ms=burst_gap_ms, deadline_ms=deadline_ms,
         distinct_keys=distinct_keys, gate=gate, gate_mix=gate_mix,
-        tenant_mix=tenant_mix, tier_mix=tier_mix))
+        tenant_mix=tenant_mix, tier_mix=tier_mix, zipf_s=zipf_s,
+        zipf_universe=zipf_universe))
 
 
 def stream_with_cancels(stream, seed: int, rate: float):
@@ -352,6 +402,16 @@ def main(argv=None) -> int:
                          "'premium:1,best_effort:3' (tiers: premium, "
                          "standard, best_effort; 'off'/'none' = no tier "
                          "field)")
+    ap.add_argument("--zipf", type=float, default=None, metavar="S",
+                    help="popularity mode (ISSUE 13): draw each request's "
+                         "identity — prompt pair + seed, i.e. its semantic-"
+                         "cache content — from a Zipf(S) rank distribution "
+                         "over --zipf-universe distinct identities, on its "
+                         "own derived RNG stream (arrivals/deadlines stay "
+                         "byte-identical to the non-zipf trace)")
+    ap.add_argument("--zipf-universe", type=int, default=32, metavar="K",
+                    help="distinct request identities under --zipf "
+                         "(default 32)")
     ap.add_argument("--cancel-rate", type=float, default=0.0,
                     help="interleave seeded {'cancel': id} markers at this "
                          "per-request probability (each victim cancelled "
@@ -389,7 +449,8 @@ def main(argv=None) -> int:
             burst_size=args.burst_size, burst_gap_ms=args.burst_gap_ms,
             deadline_ms=args.deadline_ms, distinct_keys=args.distinct_keys,
             gate=gate, gate_mix=gate_mix, tenant_mix=tenant_mix,
-            tier_mix=tier_mix)
+            tier_mix=tier_mix, zipf_s=args.zipf,
+            zipf_universe=args.zipf_universe)
         if args.cancel_rate > 0:
             stream = stream_with_cancels(stream, args.seed,
                                          args.cancel_rate)
@@ -407,7 +468,8 @@ def main(argv=None) -> int:
         burst_size=args.burst_size, burst_gap_ms=args.burst_gap_ms,
         deadline_ms=args.deadline_ms, distinct_keys=args.distinct_keys,
         gate=gate, gate_mix=gate_mix, tenant_mix=tenant_mix,
-        tier_mix=tier_mix)
+        tier_mix=tier_mix, zipf_s=args.zipf,
+        zipf_universe=args.zipf_universe)
     if args.fault_rate > 0:
         plan_path = args.fault_plan_out or (
             args.out and args.out + ".faults.json")

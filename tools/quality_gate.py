@@ -358,6 +358,36 @@ def _slo():
     return policy, durability
 
 
+def _cache_parity():
+    """The semantic-caching contract (ISSUE 13), two halves:
+
+    1. **Parity + coverage** — ``chaos_drill.cache_parity_drill``: a
+       seeded ``--zipf 1.1`` repeat-heavy gated trace served cached vs
+       uncached must be bitwise-identical on every ok output (the drill
+       raises otherwise) with ≥30% of requests served from cache and at
+       least one hit in EVERY layer (L1 encoder outputs, L2 carry
+       prefixes — exercised via real L3 evictions under a tight byte
+       budget — and L3 exact results).
+    2. **Durability** — ``chaos_drill.cache_insert_kill_drill``: a chaos
+       ``kill_after_cache_insert`` dies between the leader's L3 insert
+       and its terminal fsync; the restart must reseed off the journaled
+       ``cache`` record and serve leader + followers from the durable
+       insert, exactly-once, bitwise."""
+    import importlib.util
+    import tempfile
+
+    spec = importlib.util.spec_from_file_location(
+        "p2p_chaos_drill", os.path.join(_REPO, "tools", "chaos_drill.py"))
+    drill = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(drill)
+
+    pipe = drill.tiny_pipeline()
+    parity = drill.cache_parity_drill(pipe)
+    jpath = os.path.join(tempfile.mkdtemp(prefix="p2p-cache-"), "cache.wal")
+    durability = drill.cache_insert_kill_drill(pipe, jpath)
+    return parity, durability
+
+
 def _soak():
     """The opt-in long-horizon soak rehearsal (ISSUE 9 acceptance): ≥500
     virtual-clock-served requests across ≥5 snapshot/compact/restart
@@ -456,7 +486,11 @@ def _static_analysis():
     report = report_mod.run_all(buckets=(1,), collective_dps=(2,))
     new = report["ast"]["summary"]["new"]
     contract_fails = [r for r in report["contracts"]["results"] if not r.ok]
-    key_fails = [v for v in report["compile_key"]["fields"] if not v.ok]
+    # Compile-key and content-key sweeps share the verdict line: both are
+    # per-field completeness checks over the same Request schema (program
+    # identity and output identity respectively — ISSUE 13).
+    key_fails = [v for v in (report["compile_key"]["fields"]
+                             + report["content_key"]["fields"]) if not v.ok]
     shard_fails = [r for r in report["collectives"]["results"] if not r.ok]
     shard_bytes = sum(row["bytes_per_step"]
                       for row in report["collectives"]["table"].values())
@@ -468,7 +502,9 @@ def _static_analysis():
     detail += ["  " + v.format() for v in key_fails]
     detail += ["  " + r.format() for r in shard_fails]
     return (report["ok"], new, len(report["contracts"]["results"]),
-            len(contract_fails), len(report["compile_key"]["fields"]),
+            len(contract_fails),
+            len(report["compile_key"]["fields"])
+            + len(report["content_key"]["fields"]),
             len(key_fails), len(report["collectives"]["results"]),
             len(shard_fails), shard_bytes, detail)
 
@@ -522,6 +558,10 @@ def main(argv=None) -> int:
                          "~20s: the virtual-clock 2x-overload policy "
                          "drill + the preempt_then_kill durability "
                          "drill)")
+    ap.add_argument("--skip-cache", action="store_true",
+                    help="skip the semantic-caching check (ISSUE 13; "
+                         "~30s: the zipf cached-vs-uncached parity drill "
+                         "+ the kill_after_cache_insert durability drill)")
     ap.add_argument("--soak", action="store_true",
                     help="also run the opt-in soak rehearsal (ISSUE 9): "
                          "≥500 requests across ≥5 snapshot/compact/"
@@ -551,13 +591,13 @@ def main(argv=None) -> int:
                                        "obs_overhead", "fault_drill",
                                        "static_analysis", "flight_parity",
                                        "bench_trend", "lifecycle", "soak",
-                                       "mesh_parity", "slo"}
+                                       "mesh_parity", "slo", "cache_parity"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
                      f"valid: {', '.join(cases)}, phase_gate, serve_parity, "
                      f"obs_overhead, fault_drill, static_analysis, "
                      f"flight_parity, bench_trend, lifecycle, soak, "
-                     f"mesh_parity, slo")
+                     f"mesh_parity, slo, cache_parity")
 
     drifted = []
     for name, fn in cases.items():
@@ -732,6 +772,34 @@ def main(argv=None) -> int:
                   f"{'ok' if ok else 'DRIFT'}")
             if not ok:
                 drifted.append("slo")
+
+    if not args.skip_cache and (only is None or "cache_parity" in only):
+        try:
+            parity, durability = _cache_parity()
+        except AssertionError as e:  # DrillFailure: an invariant broke
+            print(f"{'cache_parity':16s} INVARIANT VIOLATED: {e}")
+            drifted.append("cache_parity")
+        else:
+            ok = (parity["served_from_cache_fraction"] >= 0.3
+                  and parity["l1_hits"] >= 1
+                  and parity["l2_hits"] >= 1
+                  and parity["l3_hits"] >= 1
+                  and parity["l3_evictions"] >= 1
+                  and durability["killed"]
+                  and durability["followers_bitwise"] == 2
+                  and durability["restart_served_from_cache"] >= 1
+                  and durability["replay_skipped_corrupt"] == 0)
+            print(f"{'cache_parity':16s} "
+                  f"{parity['served_from_cache_fraction'] * 100:.0f}% "
+                  f"served from cache (l1/l2/l3 hits "
+                  f"{parity['l1_hits']}/{parity['l2_hits']}/"
+                  f"{parity['l3_hits']}, {parity['l3_evictions']} "
+                  f"evictions), {parity['amplification']}x amplification, "
+                  f"all ok outputs bitwise; insert-kill restart served "
+                  f"{durability['restart_served_from_cache']} from the "
+                  f"durable insert {'ok' if ok else 'DRIFT'}")
+            if not ok:
+                drifted.append("cache_parity")
 
     if args.soak or (only is not None and "soak" in only):
         # Opt-in volume rehearsal — minutes of fake-runner traffic; the
