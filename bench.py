@@ -148,8 +148,8 @@ _TIMEOUT = object()  # sentinel: the inner subprocess hit its timeout
 # past the gate step — an *algorithmic* win, reported with per-phase ms/step
 # so the trajectory can tell it apart from kernel wins).
 _BLOCK_KEYS = ("gsweep", "gate", "dpm", "dpm_batched", "reweight",
-               "refine_blend", "ldm256", "serve", "obs", "resilience",
-               "nullinv")
+               "refine_blend", "ldm256", "serve", "obs", "cost",
+               "resilience", "nullinv")
 
 
 def _secondaries_filter(preset, env_value):
@@ -1099,6 +1099,74 @@ def _measure(preset):
                 "step_events": int(steps_seen),
             }
 
+        # Cost-observatory block (ISSUE 14): the tool-derived form of the
+        # PERF.md headline arithmetic, measured per round on the round's
+        # own hardware. The U-Net step program at the headline CFG batch
+        # (the unit prof_breakdown and the 40.75 ms/step verdict measure)
+        # gets an XLA cost card (obs/costmodel.py: flops, bytes accessed,
+        # roofline verdict, model-predicted ms vs the platform peaks —
+        # datasheet on chip, calibrated microbenchmarks at rehearsal) and
+        # a measured scan timing, so the BENCH schema carries
+        # step_mfu_pct as a benchwatch headline (higher is better) — a
+        # regression that wastes the chip shows up as a number, not as
+        # prose in PERF.md.
+        def cost_observatory():
+            from p2p_tpu.models import unet_layout
+            from p2p_tpu.models.unet import apply_unet
+            from p2p_tpu.obs import costmodel
+
+            layout = unet_layout(cfg.unet)
+            b_unet = 2 * len(prompts)          # CFG-doubled U-Net batch
+            s = cfg.latent_size
+            x = jnp.ones((b_unet, s, s, cfg.unet.in_channels), dtype)
+            ctx_b = jnp.ones((b_unet, cfg.unet.context_len,
+                              cfg.unet.context_dim), dtype)
+            single = jax.jit(lambda p, x, c: apply_unet(
+                p, cfg.unet, x, jnp.int32(1), c, layout=layout)[0])
+            card = costmodel.card_from_compiled(
+                single.lower(pipe.unet_params, x, ctx_b).compile(),
+                program=f"unet_step_b{b_unet}")
+
+            @jax.jit
+            def unet_scan(p, x, c):
+                def body(h, t):
+                    eps, _ = apply_unet(p, cfg.unet, h, t, c,
+                                        layout=layout)
+                    return eps, None
+                out, _ = jax.lax.scan(
+                    body, x, jnp.arange(num_steps, dtype=jnp.int32))
+                return out
+
+            np.asarray(unet_scan(pipe.unet_params, x, ctx_b))  # compile
+            best_s = min(
+                costmodel._timed(lambda: np.asarray(
+                    unet_scan(pipe.unet_params, x, ctx_b)))
+                for _ in range(2))
+            ms_per_step = best_s / num_steps * 1000.0
+            peaks = costmodel.detect_peaks()
+            roof = costmodel.roofline(card.flops, card.bytes_accessed,
+                                      peaks)
+            mfu = costmodel.mfu_pct(card.flops, ms_per_step, peaks)
+            extras["cost"] = {
+                "program": card.program,
+                "unet_batch": b_unet,
+                "flops_per_step": card.flops,
+                "bytes_per_step": card.bytes_accessed,
+                "arith_intensity": round(roof["arith_intensity"], 3),
+                "roofline": roof["bound"],
+                "predicted_ms_per_step": round(roof["predicted_ms"], 3),
+                "measured_ms_per_step": round(ms_per_step, 3),
+                "peak_flops_per_s": peaks.flops_per_s,
+                "peak_bytes_per_s": peaks.bytes_per_s,
+                "peak_source": peaks.source,
+                "platform": platform,
+            }
+            if mfu is not None:
+                # Absent (n/a to benchwatch), never 0.0: a backend with
+                # no cost analysis is a measurement gap, not the worst
+                # possible value of a higher-is-better headline.
+                extras["cost"]["step_mfu_pct"] = round(mfu, 2)
+
         # Resilience block (ISSUE 4): the standard seeded chaos drill
         # (tools/chaos_drill.py) through this preset's pipeline — clean run,
         # faulted run under the seed-8 fault plan, and a simulated
@@ -1173,6 +1241,11 @@ def _measure(preset):
         secondary("serve", "serve rehearsal secondary", serve_rehearsal,
                   needs_sweep=True)
         secondary("obs", "obs overhead secondary", obs_overhead)
+        # min_left=420: at full scale the num_steps scan is a fresh XLA
+        # program (warm persistent cache makes it disk I/O; a cold-cache
+        # window needs the compile window nullinv also reserves).
+        secondary("cost", "cost observatory secondary", cost_observatory,
+                  min_left=420)
         secondary("resilience", "resilience drill secondary",
                   resilience_drill, needs_sweep=True)
         # min_left=420: the warm-cache need is two sampling-scale passes

@@ -36,8 +36,11 @@ REPORT_VERSION = 2
 
 #: Selectable report sections (the ``only=`` vocabulary). ``ast`` is pass
 #: 1; ``contracts`` bundles the jaxpr contracts with the compile-key sweep
-#: (they share the traced canonical set); ``collectives`` is shardcheck.
-SECTIONS = ("ast", "contracts", "collectives")
+#: (they share the traced canonical set); ``collectives`` is shardcheck;
+#: ``cost`` is the cost observatory's canonical pass (XLA cost cards for
+#: the canonical serve programs, diffed against the frozen budgets in
+#: ``tools/cost_budgets.json`` — ISSUE 14).
+SECTIONS = ("ast", "contracts", "collectives", "cost")
 
 #: Default lint targets, relative to the repo root: the package plus the
 #: drivers that embed repo invariants. tests/ is deliberately out — tests
@@ -48,7 +51,7 @@ SECTIONS = ("ast", "contracts", "collectives")
 DEFAULT_LINT_PATHS = ("p2p_tpu", "tools/quality_gate.py",
                       "tools/jaxcheck.py", "tools/loadgen.py",
                       "tools/chaos_drill.py", "tools/check_checkpoint.py",
-                      "tools/parity_real_weights.py",
+                      "tools/parity_real_weights.py", "tools/perfscope.py",
                       "bench.py", "__graft_entry__.py")
 
 DEFAULT_BASELINE = os.path.join("tools", "jaxcheck_baseline.json")
@@ -129,23 +132,56 @@ def run_collectives_pass(pipe=None, collective_dps=None) -> dict:
                             "table": table}}
 
 
+def run_cost_pass(pipe=None, budgets_path: Optional[str] = None,
+                  root: Optional[str] = None) -> dict:
+    """Pass 4: the cost observatory's canonical pass (ISSUE 14) — compile
+    the canonical serve programs, extract their XLA cost cards
+    (``obs.costmodel``), and diff the budget-frozen fields against
+    ``tools/cost_budgets.json``. Lazy-imported like the other traced
+    passes (this one additionally pays an XLA compile per program)."""
+    from ..obs import costmodel
+
+    cards = costmodel.canonical_cost_cards(pipe)
+    if budgets_path is None:
+        budgets_path = os.path.join(root or repo_root(),
+                                    costmodel.DEFAULT_BUDGETS)
+    budget = costmodel.load_budgets(budgets_path)
+    verdicts = costmodel.check_budgets(cards, budget)
+    return {"cost": {"programs": cards,
+                     "budget": verdicts,
+                     "ok": all(v.ok for v in verdicts)}}
+
+
 def run_all(paths: Optional[Iterable[str]] = None,
             baseline_path: Optional[str] = None,
             root: Optional[str] = None,
             ast_only: bool = False,
             buckets=(1, 2, 4, 8),
             only: Optional[str] = None,
-            collective_dps=None) -> dict:
+            collective_dps=None,
+            sections: Optional[Iterable[str]] = None) -> dict:
     """Run the selected sections (default: all). ``ast_only`` is the
     historical spelling of ``only="ast"``; ``only`` narrows to one section
-    (``tools/jaxcheck.py --only``); ``collective_dps`` narrows the
-    shardcheck dp sweep (the quality gate runs one dp for speed, the
-    analyzer's own tests sweep the axis)."""
+    (``tools/jaxcheck.py --only``); ``sections`` picks an explicit subset
+    (the quality gate's ``static_analysis`` check runs the three analyzer
+    passes here and the ``cost`` pass in its own ``cost_regression`` leg,
+    so the canonical programs compile once per gate run, not twice);
+    ``collective_dps`` narrows the shardcheck dp sweep (the quality gate
+    runs one dp for speed, the analyzer's own tests sweep the axis)."""
     if only is not None and only not in SECTIONS:
         raise ValueError(f"only must be one of {SECTIONS}, got {only!r}")
     if ast_only:
         only = "ast"
-    sections = SECTIONS if only is None else (only,)
+    if only is not None:
+        sections = (only,)
+    elif sections is None:
+        sections = SECTIONS
+    else:
+        sections = tuple(sections)
+        unknown = set(sections) - set(SECTIONS)
+        if unknown:
+            raise ValueError(f"sections must be from {SECTIONS}, "
+                             f"got {sorted(unknown)}")
     report: dict = {"version": REPORT_VERSION}
     oks = []
     if "ast" in sections:
@@ -153,8 +189,9 @@ def run_all(paths: Optional[Iterable[str]] = None,
         report["ast"] = ast
         oks.append(ast["summary"]["new"] == 0)
     pipe = None
-    if "contracts" in sections or "collectives" in sections:
-        # Both traced passes share one tiny pipeline (same construction,
+    if ("contracts" in sections or "collectives" in sections
+            or "cost" in sections):
+        # The traced passes share one tiny pipeline (same construction,
         # no reason to re-init weights per pass).
         from . import contracts as contracts_mod
 
@@ -168,6 +205,10 @@ def run_all(paths: Optional[Iterable[str]] = None,
         coll = run_collectives_pass(pipe, collective_dps=collective_dps)
         report.update(coll)
         oks.append(coll["collectives"]["ok"])
+    if "cost" in sections:
+        cost = run_cost_pass(pipe, root=root)
+        report.update(cost)
+        oks.append(cost["cost"]["ok"])
     report["ok"] = all(oks)
     return report
 
@@ -207,6 +248,11 @@ def to_json_dict(report: dict) -> dict:
             "results": [r.to_dict()
                         for r in report["collectives"]["results"]],
             "table": report["collectives"]["table"]}
+    if "cost" in report:
+        out["cost"] = {
+            "ok": report["cost"]["ok"],
+            "programs": report["cost"]["programs"],
+            "budget": [v.to_dict() for v in report["cost"]["budget"]]}
     return out
 
 
@@ -258,6 +304,20 @@ def render_text(report: dict, verbose: bool = False) -> str:
             row = c["table"][name]
             lines.append(f"    {name:26s} {row['bytes_per_step']:>10d} | "
                          f"{row['bytes_once']:>10d} | {row['ops'] or '{}'}")
+    if "cost" in report:
+        c = report["cost"]
+        lines.append(f"Cost pass: "
+                     f"{sum(1 for v in c['budget'] if not v.ok)} budget "
+                     f"violation(s) across {len(c['budget'])} check(s)")
+        for v in c["budget"]:
+            if not v.ok or verbose:
+                lines.append("  " + v.format())
+        lines.append("  cost cards (flops | bytes accessed | intensity):")
+        for name in sorted(c["programs"]):
+            card = c["programs"][name]
+            lines.append(f"    {name:26s} {card['flops']:>14.4g} | "
+                         f"{card['bytes_accessed']:>14.4g} | "
+                         f"{card['arith_intensity']:>7.2f}")
     lines.append("static analysis " + ("PASSED" if report["ok"]
                                        else "FAILED"))
     return "\n".join(lines)

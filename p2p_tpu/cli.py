@@ -498,6 +498,11 @@ def cmd_serve(args) -> int:
         from .obs import flight as obs_flight
 
         flight_tracer = obs_flight.FlightTracer(blackbox_dir=args.blackbox)
+    costscope = None
+    if args.cost or args.programs_out:
+        from .obs import costmodel as obs_costmodel
+
+        costscope = obs_costmodel.CostScope()
     pipe = _build_pipeline(args)
     stream = sys.stdin if args.requests == "-" else open(args.requests)
     items = []
@@ -626,6 +631,7 @@ def cmd_serve(args) -> int:
                     mesh=mesh_spec,
                     slo=slo,
                     semcache=semcache,
+                    costscope=costscope,
                     flight=flight_tracer,
                     lifecycle=drain_ctl,
                     snapshot_every_ms=args.snapshot_every_ms,
@@ -640,6 +646,14 @@ def cmd_serve(args) -> int:
             journal.close()
         if out is not sys.stdout:
             out.close()
+        if costscope is not None and args.programs_out:
+            # Written in the finally so a fatal drain's cards (and a
+            # partially-drained trace) still produce the artifact.
+            os.makedirs(os.path.dirname(args.programs_out) or ".",
+                        exist_ok=True)
+            with open(args.programs_out, "w") as f:
+                costscope.write_programs_jsonl(f)
+            print(f"wrote {args.programs_out}", file=sys.stderr)
         if flight_tracer is not None:
             # Written in the finally so a fatal drain's records (and a
             # partially-drained trace) still produce the artifacts.
@@ -1038,6 +1052,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "reusing the directory across restarts is what "
                         "lets a journaled insert serve followers after a "
                         "crash")
+    s.add_argument("--cost", action="store_true",
+                   help="enable the cost observatory (obs/costmodel.py, "
+                        "docs/OBSERVABILITY.md): every program-cache miss "
+                        "records an XLA cost card (flops, bytes, roofline "
+                        "verdict, predicted ms) with compile_ms split into "
+                        "build vs warm, every dispatch a measured-MFU "
+                        "observation, and the summary gains a `cost` "
+                        "block; per-request records are byte-identical "
+                        "either way")
+    s.add_argument("--programs-out", default=None, metavar="FILE",
+                   help="write one JSON line per recorded program cost "
+                        "card after the trace drains (implies --cost); "
+                        "the artifact tools/perfscope.py --programs "
+                        "renders")
     s.add_argument("--cache-l3-bytes", type=int, default=None, metavar="B",
                    help="in-memory byte budget for the exact-result layer "
                         "(LRU; eviction deletes the spill too; "

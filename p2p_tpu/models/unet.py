@@ -258,12 +258,27 @@ class SpConfig:
 
 def _apply_attention(p: Params, x: jax.Array, context: jax.Array, heads: int,
                      ctx: _HookCtx, is_cross: bool) -> jax.Array:
-    """One attention site. x: (B, P, C); context: (B, K, Cc)."""
+    """One attention site. x: (B, P, C); context: (B, K, Cc).
+
+    Every site's computation is wrapped in a ``jax.named_scope`` whose
+    name encodes the site identity (``cross_attn/down3`` etc. — place +
+    global layer index from the :class:`AttnMeta`): the scope lands in
+    the HLO op metadata, so a Perfetto/XProf device trace splits step
+    time *per attention site* — the per-site cost attribution the
+    TAD-style reuse-schedule search (ROADMAP item 1) keys on. A trace-
+    time name only: the lowered ops, numerics and jaxpr structure are
+    identical with or without it."""
     meta = ctx.next_meta()
     assert meta.is_cross == is_cross, (
         f"layout order mismatch at site {meta.layer_idx}: layout says "
         f"is_cross={meta.is_cross}, model called is_cross={is_cross}")
+    with jax.named_scope(f"{'cross_attn' if is_cross else 'self_attn'}"
+                         f"/{meta.place}{meta.layer_idx}"):
+        return _attention_site(p, x, context, heads, ctx, meta, is_cross)
 
+
+def _attention_site(p: Params, x: jax.Array, context: jax.Array, heads: int,
+                    ctx: _HookCtx, meta, is_cross: bool) -> jax.Array:
     if is_cross and ctx.cache_mode == "use":
         # Phase 2 of gated sampling: the text context is untouched past the
         # gate, so this site's output is the cached last-phase-1-step tensor.

@@ -16,10 +16,18 @@ docs/OBSERVABILITY.md for the metric catalog and span taxonomy):
   post-mortem recorder.
 - :mod:`.device` — the host half of the compiled-loop callback channel
   (``utils.progress.emit_step``/``emit_event``): per-phase step timing,
-  compile-time recording, device ``memory_stats()`` gauges. Imported
+  compile-time recording, per-device ``memory_stats()`` gauges. Imported
   explicitly (``from p2p_tpu.obs import device``) because it pulls jax;
   this package root stays jax-free so CLI parsing and the serve data
   structures can import metrics/spans without a backend.
+- :mod:`.costmodel` — the cost observatory (ISSUE 14): XLA cost cards
+  (``cost_analysis``/``memory_analysis``), the per-platform peak table
+  (datasheet on chip, calibrated microbenchmarks on a CPU rehearsal
+  host), roofline/MFU arithmetic, the frozen canonical budgets behind
+  the ``cost_regression`` gate, and the serve engine's ``CostScope``
+  hook. Imported explicitly for the same jax-at-import reason as
+  ``device`` (jax only inside functions, but its consumers are all
+  jax-side).
 
 The TPU-native discipline: disabling telemetry traces *nothing* into any
 XLA program (the ``emit_step(enabled=False)`` contract, pinned by jaxpr

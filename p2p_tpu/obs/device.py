@@ -20,8 +20,9 @@ as the callbacks land and folding them into the default metrics registry:
   (e.g. ``invert.inner_steps``, the per-outer-step null-text inner
   iteration count).
 
-:func:`sample_device_memory` reads ``jax.local_devices()[0].memory_stats()``
-into ``device_memory_bytes{stat=...}`` gauges — present on TPU backends,
+:func:`sample_device_memory` reads every local device's ``memory_stats()``
+into ``device_memory_bytes{device=...,stat=...}`` gauges (one timeline per
+mesh shard, PR 9's per-device convention) — present on TPU backends,
 silently absent on CPU (the method returns None there), never an error.
 
 :func:`record_compile` is the shared counter for compile/build time hits —
@@ -108,28 +109,45 @@ def instrument(registry: Optional[metrics_mod.Registry] = None):
 
 def sample_device_memory(
         registry: Optional[metrics_mod.Registry] = None) -> dict:
-    """Sample the first local device's ``memory_stats()`` into gauges.
-    Returns the sampled dict ({} when the backend exposes nothing — CPU)."""
+    """Sample EVERY local device's ``memory_stats()`` into gauges with a
+    ``device`` label (PR 9's per-device metric convention — under
+    ``--mesh`` each shard's HBM pressure is its own timeline, exactly
+    what the eviction/degradation ladder needs to see per device).
+    Returns ``{device_id: {stat: value}}`` — {} when the backend exposes
+    nothing (CPU returns no memory_stats; never an error)."""
     try:
         import jax
 
-        stats = jax.local_devices()[0].memory_stats() or {}
+        devices = jax.local_devices()
     except Exception:
         return {}
     reg = registry or metrics_mod.registry()
     gauge = reg.gauge("device_memory_bytes",
-                      "jax device memory_stats() samples", labels=("stat",))
-    out = {}
-    for key, val in stats.items():
-        if isinstance(val, (int, float)):
-            gauge.labels(stat=str(key)).set(float(val))
-            out[str(key)] = val
+                      "jax device memory_stats() samples per local device",
+                      labels=("device", "stat"))
+    out: dict = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        sampled = {}
+        for key, val in stats.items():
+            if isinstance(val, (int, float)):
+                gauge.labels(device=str(getattr(d, "id", "?")),
+                             stat=str(key)).set(float(val))
+                sampled[str(key)] = val
+        if sampled:
+            out[str(getattr(d, "id", "?"))] = sampled
     return out
 
 
 def record_compile(ms: float, what: str = "program",
                    registry: Optional[metrics_mod.Registry] = None) -> None:
-    """One compile/build observation (``what``: e.g. 'program', 'prewarm')."""
+    """One compile/build observation. ``what``: 'program' (a whole
+    ProgramCache miss, build+warm lump) — decomposed under the cost
+    observatory into 'build' (lowering + XLA compile) vs 'warm' (warm-up
+    execution), so cost cards can attribute the two separately."""
     reg = registry or metrics_mod.registry()
     reg.counter("compiles_total", "program builds recorded",
                 labels=("what",)).labels(what=what).inc()

@@ -110,6 +110,7 @@ def sweep(
     progress: bool = False,
     gate=None,
     metrics: bool = False,
+    lower_only: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Run G independent edit groups; shard the group axis over ``dp``.
 
@@ -137,6 +138,14 @@ def sweep(
     phase-tagged telemetry callback in exactly as in ``text2image`` —
     ``obs.device.instrument`` collects it; disabled, the program is
     unchanged. Returns ``(images (G,B,H,W,3) uint8, final latents)``.
+
+    ``lower_only=True`` returns the ``jax.stages.Lowered`` for this exact
+    program instead of executing it — the cost observatory's entry point
+    (``obs.costmodel``): ``.compile()`` on the result yields the XLA
+    ``cost_analysis()``/``memory_analysis()`` the cost cards are built
+    from. Nothing is staged onto a device in this mode (the program is
+    lowered mesh-less: a cost card describes the logical computation;
+    the scope scales peaks by the device count separately).
     """
     cfg = pipe.config
     if layout is None:
@@ -172,6 +181,16 @@ def sweep(
     # implicit jnp.asarray(float) h2d would raise (already-on-device values
     # pass through untouched). On a mesh the scalar stages replicated
     # under an explicit NamedSharding (same contract, mesh form).
+    if lower_only:
+        # Cost-card path: lower the exact program (same static args, same
+        # avals) without staging or executing anything. A concrete host
+        # scalar stands in for the staged guidance — same dtype/shape, so
+        # the lowered HLO is the dispatched program's.
+        return _sweep_jit.lower(
+            pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
+            scheduler, context, latents, controllers,
+            np.float32(guidance_scale), uncond_per_step,
+            progress=progress, gate=gate_step, metrics=metrics)
     gs = (guidance_scale if isinstance(guidance_scale, jax.Array)
           else stage_host(np.float32(guidance_scale), mesh=mesh))
 
@@ -303,16 +322,24 @@ def sweep_phase1(
     gate=None,
     progress: bool = False,
     metrics: bool = False,
+    lower_only: bool = False,
 ) -> PhaseCarry:
     """Run phase 1 of G groups (same shapes/semantics as :func:`sweep`) and
     return the hand-off carry instead of images. ``gate`` must resolve
     strictly inside ``(0, S)``. ``mesh`` shards the group axis over ``dp``
     exactly as in :func:`sweep` — the returned carry leaves come out
-    sharded the same way (the hand-off stays on device)."""
+    sharded the same way (the hand-off stays on device).
+    ``lower_only=True`` returns the program's ``Lowered`` instead of
+    executing (the cost-card path — see :func:`sweep`)."""
     cfg, layout, schedule, gate_step, gs = _phase_args(
         pipe, num_steps, scheduler, gate, guidance_scale, layout,
         controllers, mesh=mesh)
     warn_gate_truncation(gate_step, schedule.timesteps.shape[0], controllers)
+    if lower_only:
+        return _sweep_phase1_jit.lower(
+            pipe.unet_params, cfg, layout, schedule, scheduler, context,
+            latents, controllers, np.float32(guidance_scale),
+            progress=progress, gate=gate_step, metrics=metrics)
     if mesh is not None:
         gspec = NamedSharding(mesh, P("dp"))
         context = _stage_sharded(context, gspec)
@@ -345,6 +372,7 @@ def sweep_phase2(
     gate=None,
     progress: bool = False,
     metrics: bool = False,
+    lower_only: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Finish G hand-off carries: steps ``[gate, S)`` + VAE decode.
     ``controllers`` must already be the phase-2 slice
@@ -359,6 +387,12 @@ def sweep_phase2(
     cfg, layout, schedule, gate_step, gs = _phase_args(
         pipe, num_steps, scheduler, gate, guidance_scale, layout,
         controllers, mesh=mesh)
+    if lower_only:
+        return _sweep_phase2_jit.lower(
+            pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
+            scheduler, context_cond, carry, controllers,
+            np.float32(guidance_scale), progress=progress, gate=gate_step,
+            metrics=metrics)
     if mesh is not None:
         gspec = NamedSharding(mesh, P("dp"))
         context_cond = _stage_sharded(context_cond, gspec)

@@ -152,6 +152,7 @@ import dataclasses
 import time
 from typing import Callable, Iterable, Iterator, List, Optional
 
+from ..obs import device as obs_device
 from ..obs import metrics as obs_metrics
 from ..obs import spans as obs_spans
 from ..obs.spans import span
@@ -329,6 +330,7 @@ def serve_forever(
     mesh=None,
     slo=None,
     semcache=None,
+    costscope=None,
 ) -> Iterator[dict]:
     """Drain ``requests`` (Request/Cancel objects or JSONL-shaped dicts,
     sorted by ``arrival_ms``) through the queue → batcher → program-cache →
@@ -430,6 +432,22 @@ def serve_forever(
     L2 spill disk is shed *before* any request is. ``semcache=None``
     (the default) changes nothing — not a record byte, a journal line,
     a compiled program or a metric family.
+
+    ``costscope`` (None | ``obs.costmodel.CostScope``) enables the cost
+    observatory (ISSUE 14, docs/OBSERVABILITY.md "Cost observatory"):
+    every ``ProgramCache`` miss lowers+compiles the program's cost card
+    (XLA ``cost_analysis``/``memory_analysis`` → flops, bytes, roofline
+    verdict, model-predicted ms) with the miss's ``compile_ms`` split
+    into ``build`` (lowering + XLA compile) vs ``warm`` (warm-up
+    execution); every dispatch contributes a measured-MFU observation
+    (``flops ÷ run_s ÷ peak``); flight ``run`` segments gain
+    ``predicted_ms``/``mfu_pct`` attribution when a tracer is also
+    armed; and the summary gains a ``cost`` block. The per-request
+    record stream stays byte-identical either way — cost facts live in
+    the summary, the metrics registry and the ``--programs-out``
+    artifact, never in a request record or journal line.
+    ``costscope=None`` (the default) changes nothing, same discipline
+    as the other sidecars.
     """
     from ..engine.sampler import lane_select
     from ..utils import progress as progress_mod
@@ -442,6 +460,10 @@ def serve_forever(
     jmesh = None if mesh_spec is None else meshing_mod.build_mesh(mesh_spec)
     sizes = (BUCKET_SIZES if mesh_spec is None
              else meshing_mod.scaled_bucket_sizes(dp))
+    if costscope is not None:
+        # The scope scales peaks by the mesh width: a dp-sharded dispatch
+        # runs its (global-batch) program across dp devices' peaks.
+        costscope.devices = max(1, dp)
 
     def mkey(key):
         """Program-cache key for one dispatch: the mesh shape joins it so
@@ -513,6 +535,10 @@ def serve_forever(
     handoffs_total = 0
     resumed_handoffs = 0
     prewarm_ms = 0.0
+    # Cost-observatory dispatch attribution (obs.costmodel): the latest
+    # dispatch's predicted-vs-measured attrs, merged into flight `run`
+    # segments. Stays {} with costscope=None (flight parity unchanged).
+    last_cost = [{}]
     vnow = 0.0
     batch_index = 0
     replayed_ids: set = set()
@@ -775,7 +801,53 @@ def serve_forever(
     def _build(factory, compile_key, bucket, entries):
         runner = factory(compile_key, bucket)
         warm = getattr(runner, "warm", None)
-        if warm is not None:
+        lower = (getattr(runner, "cost_lowered", None)
+                 if costscope is not None else None)
+        if lower is not None and jmesh is None:
+            # Cost observatory: AOT-compile FIRST — the real XLA compile
+            # is timed as compile_ms{what="build"} and populates the
+            # persistent cache, so the jit-path warm that follows mostly
+            # pays deserialization + the throwaway execution, timed as
+            # {what="warm"}. The miss's what="program" lump (recorded by
+            # ProgramCache) stays the total either way; build/warm is its
+            # decomposition, present only under the observatory.
+            compiled = None
+            t0 = time.perf_counter()
+            try:
+                compiled = lower(entries).compile()
+            except Exception:
+                pass  # a card-less program still serves; never a fault
+            build_ms = (time.perf_counter() - t0) * 1000.0
+            obs_device.record_compile(build_ms, what="build")
+            t1 = time.perf_counter()
+            if warm is not None:
+                warm(entries)
+            warm_ms = (time.perf_counter() - t1) * 1000.0
+            obs_device.record_compile(warm_ms, what="warm")
+            if compiled is not None:
+                costscope.record_program(compile_key, bucket, compiled,
+                                         build_ms=build_ms,
+                                         warm_ms=warm_ms)
+        elif lower is not None:
+            # Mesh serving: the card comes from the MESH-LESS logical
+            # twin (cost_lowered lowers without shardings), which shares
+            # no compile with the sharded program warm() builds — so the
+            # real serving build runs FIRST (the warm), and the twin's
+            # analysis compile is an observatory cost on top, recorded
+            # under its own label instead of polluting the build/warm
+            # decomposition (whose meaning is the serving path's split).
+            if warm is not None:
+                warm(entries)
+            t0 = time.perf_counter()
+            try:
+                compiled = lower(entries).compile()
+            except Exception:
+                compiled = None
+            card_ms = (time.perf_counter() - t0) * 1000.0
+            obs_device.record_compile(card_ms, what="cost_card")
+            if compiled is not None:
+                costscope.record_program(compile_key, bucket, compiled)
+        elif warm is not None:
             warm(entries)
         return runner
 
@@ -1200,6 +1272,7 @@ def serve_forever(
         never mutate the LRU if it eventually wakes up."""
         steps_seen = []
         beats = [0]
+        last_cost[0] = {}
         if watchdog_ms is not None:
             # Armed before the build: warm() runs the compiled loop, whose
             # step callbacks re-arm the deadline — only a compile that
@@ -1250,6 +1323,11 @@ def serve_forever(
             if watchdog_ms is not None:
                 progress_mod.set_watchdog_sink(None)
         run_ms = (timer() - t0) * 1000.0
+        if costscope is not None:
+            # One measured-MFU observation per dispatch; the returned
+            # attrs ride the flight run segment (predicted-vs-measured).
+            last_cost[0] = costscope.dispatch(compile_key, bucket, run_ms,
+                                              lanes=len(entries))
         finite = (getattr(runner, "last_lane_finite", None)
                   if validate_outputs else None)
         return imgs, run_ms, hit, (
@@ -1427,7 +1505,8 @@ def serve_forever(
                 flight.segment(e.request_id, "compile", v0, compile_ms,
                                pool="mono", cache_hit=hit)
                 flight.segment(e.request_id, "run", v0 + compile_ms, run_ms,
-                               pool="mono", batch_id=this_batch)
+                               pool="mono", batch_id=this_batch,
+                               **last_cost[0])
         occupancies.append(len(live))
         # Observed only on success, next to the summary's list, so the
         # histogram and mean_batch_occupancy reconcile exactly (a poisoned
@@ -1538,7 +1617,7 @@ def serve_forever(
                                pool="mono", cache_hit=hit, isolated=True)
                 flight.segment(e.request_id, "run", v0 + compile_ms, run_ms,
                                pool="mono", batch_id=batch_index,
-                               isolated=True)
+                               isolated=True, **last_cost[0])
             occupancies.append(1)
             # success-only, mirroring dispatch()
             m_occupancy.labels(phase="mono").observe(1.0)
@@ -1885,7 +1964,8 @@ def serve_forever(
                 flight.segment(e.request_id, "compile", v0, compile_ms,
                                pool="phase1", cache_hit=hit)
                 flight.segment(e.request_id, "run", v0 + compile_ms, run_ms,
-                               pool="phase1", batch_id=this_batch)
+                               pool="phase1", batch_id=this_batch,
+                               **last_cost[0])
         occupancies.append(len(live))
         occ_by_phase["phase1"].append(len(live))
         m_occupancy.labels(phase="phase1").observe(float(len(live)))
@@ -1956,7 +2036,7 @@ def serve_forever(
                                pool="phase1", cache_hit=hit, isolated=True)
                 flight.segment(e.request_id, "run", v0 + compile_ms,
                                run_ms, pool="phase1", batch_id=batch_index,
-                               isolated=True)
+                               isolated=True, **last_cost[0])
             occupancies.append(1)
             occ_by_phase["phase1"].append(1)
             m_occupancy.labels(phase="phase1").observe(1.0)
@@ -2140,7 +2220,8 @@ def serve_forever(
                 flight.segment(e.request_id, "compile", v0, compile_ms,
                                pool="phase2", cache_hit=hit)
                 flight.segment(e.request_id, "run", v0 + compile_ms, run_ms,
-                               pool="phase2", batch_id=this_batch)
+                               pool="phase2", batch_id=this_batch,
+                               **last_cost[0])
         occupancies.append(len(live))
         occ_by_phase["phase2"].append(len(live))
         m_occupancy.labels(phase="phase2").observe(float(len(live)))
@@ -2233,7 +2314,7 @@ def serve_forever(
                                pool="phase2", cache_hit=hit, isolated=True)
                 flight.segment(e.request_id, "run", v0 + compile_ms,
                                run_ms, pool="phase2", batch_id=batch_index,
-                               isolated=True)
+                               isolated=True, **last_cost[0])
             occupancies.append(1)
             occ_by_phase["phase2"].append(1)
             m_occupancy.labels(phase="phase2").observe(1.0)
@@ -2792,6 +2873,10 @@ def serve_forever(
             "tier_yields": tier_yields,
             "quota_rejects": quota_rejects,
         }
+    if costscope is not None:
+        # Present only under an active CostScope, so cost-less summaries
+        # stay byte-identical (disabled-mode parity).
+        summary["cost"] = costscope.summary()
     if sc is not None:
         # Present only under an active SemCache, so cache-less summaries
         # stay byte-identical (disabled-mode parity).

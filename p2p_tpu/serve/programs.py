@@ -225,6 +225,22 @@ class SweepRunner:
         imgs, _ = self._run(ctx, lat, ctrl, guidance=1.0)
         jax.device_get(imgs)
 
+    def cost_lowered(self, entries):
+        """The cost observatory's build-time hook (``obs.costmodel``): the
+        ``jax.stages.Lowered`` of this runner's exact program, built off
+        the same zero inputs ``warm`` compiles with. ``.compile()`` on it
+        yields the XLA cost/memory analysis for the program's cost card
+        (lowered mesh-less — the card describes the logical computation;
+        the scope scales peaks by device count)."""
+        from ..parallel import sweep
+
+        ctx, lat, ctrl = self._inputs(entries, zeros=True)
+        return sweep(self.pipe, ctx, lat, ctrl, num_steps=self.steps,
+                     guidance_scale=1.0, scheduler=self.scheduler,
+                     mesh=None, gate=self.gate_step,
+                     progress=self.progress, metrics=self.heartbeat,
+                     lower_only=True)
+
     def _run(self, ctx, lat, ctrl, guidance: float):
         from ..parallel import sweep
 
@@ -300,6 +316,16 @@ class Phase1Runner(SweepRunner):
                             scheduler=self.scheduler, mesh=self.mesh,
                             gate=self.gate_step,
                             progress=self.progress, metrics=self.heartbeat)
+
+    def cost_lowered(self, entries):
+        from ..parallel.sweep import sweep_phase1
+
+        ctx, lat, ctrl = self._inputs(entries, zeros=True)
+        return sweep_phase1(self.pipe, ctx, lat, ctrl,
+                            num_steps=self.steps, guidance_scale=1.0,
+                            scheduler=self.scheduler, mesh=None,
+                            gate=self.gate_step, progress=self.progress,
+                            metrics=self.heartbeat, lower_only=True)
 
     def warm(self, entries) -> None:
         import jax
@@ -415,10 +441,12 @@ class Phase2Runner:
                             gate=self.gate_step,
                             progress=self.progress, metrics=self.heartbeat)
 
-    def warm(self, entries) -> None:
-        """Compile-ahead off zero inputs shaped by the request alone
-        (``handoff.carry_template``), so the phase-2 program can prewarm
-        before any phase-1 batch has produced a real carry."""
+    def _template_inputs(self, entries):
+        """Zero inputs shaped by the request alone
+        (``handoff.carry_template``) — shared by :meth:`warm` (which must
+        prewarm before any phase-1 batch has produced a real carry) and
+        :meth:`cost_lowered` (whose card must describe that same
+        program)."""
         import jax
         import jax.numpy as jnp
 
@@ -431,12 +459,30 @@ class Phase2Runner:
         lead = jax.tree_util.tree_map(
             lambda x: jnp.zeros((self.bucket,) + tuple(x.shape), x.dtype),
             template)
-        ctx, carry = lead["ctx"], lead["carry"]
         ctrl = phase2_controller(prep.controller)
         ctrl_g = (None if ctrl is None else jax.tree_util.tree_map(
             lambda x: jnp.stack([x] * self.bucket), ctrl))
+        return lead["ctx"], lead["carry"], ctrl_g
+
+    def warm(self, entries) -> None:
+        """Compile-ahead off zero inputs shaped by the request alone
+        (``handoff.carry_template``), so the phase-2 program can prewarm
+        before any phase-1 batch has produced a real carry."""
+        import jax
+
+        ctx, carry, ctrl_g = self._template_inputs(entries)
         imgs, _ = self._run(ctx, carry, ctrl_g, guidance=1.0)
         jax.device_get(imgs)
+
+    def cost_lowered(self, entries):
+        from ..parallel.sweep import sweep_phase2
+
+        ctx, carry, ctrl_g = self._template_inputs(entries)
+        return sweep_phase2(self.pipe, ctx, carry, ctrl_g,
+                            num_steps=self.steps, guidance_scale=1.0,
+                            scheduler=self.scheduler, mesh=None,
+                            gate=self.gate_step, progress=self.progress,
+                            metrics=self.heartbeat, lower_only=True)
 
     def __call__(self, entries, guidance: float):
         import jax

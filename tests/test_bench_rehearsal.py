@@ -42,7 +42,23 @@ EXPECTED_KEYS = {
     # delta the fault-tolerance machinery adds under the standard seeded
     # fault plan, so the trajectory tracks what robustness costs.
     "resilience",
+    # Cost observatory (ISSUE 14): the tool-derived PERF.md arithmetic —
+    # XLA cost card of the headline U-Net step program + measured
+    # step_mfu_pct (a benchwatch headline) per round.
+    "cost",
     "nullinv_s_per_image",
+}
+
+
+#: ISSUE 14: the bench `cost` block — frozen literal like the serve
+#: sub-records: a key change is a deliberate schema change, updated in the
+#: same diff. step_mfu_pct is the benchwatch headline (higher is better).
+COST_KEYS = {
+    "program", "unet_batch",
+    "flops_per_step", "bytes_per_step", "arith_intensity",
+    "roofline", "predicted_ms_per_step", "measured_ms_per_step",
+    "step_mfu_pct",
+    "peak_flops_per_s", "peak_bytes_per_s", "peak_source", "platform",
 }
 
 
@@ -124,13 +140,14 @@ def test_rehearsal_schema_unchanged_by_static_analysis_pr():
         "reweight_eqsweep_4groups_imgs_per_s",
         "refine_localblend_imgs_per_s",
         "ldm256_8prompt_imgs_per_s",
-        "serve", "obs", "resilience",
+        "serve", "obs", "cost", "resilience",
         "nullinv_s_per_image",
     }
     bench = _import_bench()
     assert bench._BLOCK_KEYS == ("gsweep", "gate", "dpm", "dpm_batched",
                                  "reweight", "refine_blend", "ldm256",
-                                 "serve", "obs", "resilience", "nullinv")
+                                 "serve", "obs", "cost", "resilience",
+                                 "nullinv")
 
 
 def _import_bench():
@@ -636,6 +653,21 @@ def test_bench_rehearsal_green_and_complete():
     assert mb["scaling_ratio"] > 0
     assert mb["imgs_per_s_per_device"] > 0
     assert mb["dp1_makespan_ms"] > 0 and mb["mesh_makespan_ms"] > 0
+    # Cost-observatory acceptance (ISSUE 14): the frozen-key cost block
+    # carries the headline U-Net step program's XLA cost card and the
+    # measured MFU against the calibrated rehearsal peaks — flops pinned
+    # exactly deterministic, timing facts present and sane. On CPU the
+    # peaks are microbenchmark-calibrated (labeled), never the datasheet.
+    cost = doc["cost"]
+    assert set(cost) == COST_KEYS
+    assert cost["program"] == "unet_step_b4" and cost["unet_batch"] == 4
+    assert cost["flops_per_step"] > 0 and cost["bytes_per_step"] > 0
+    assert cost["roofline"] in ("compute", "bandwidth")
+    assert cost["predicted_ms_per_step"] > 0
+    assert cost["measured_ms_per_step"] > 0
+    assert cost["step_mfu_pct"] > 0
+    assert cost["peak_source"] == "calibrated"
+    assert cost["platform"] == "cpu"
     # Resilience acceptance (ISSUE 4): the standard drill must actually
     # drill — faults fired and were retried, ok outputs stayed bitwise-
     # stable vs the fault-free run (run_drill raises otherwise, failing

@@ -921,9 +921,13 @@ def test_report_verdict_flips_on_contract_class_violation(tmp_path,
     def clean_collectives(*a, **kw):
         return {"collectives": {"results": [], "ok": True, "table": {}}}
 
+    def clean_cost(*a, **kw):
+        return {"cost": {"programs": {}, "budget": [], "ok": True}}
+
     monkeypatch.setattr(report_mod, "run_contract_pass", seeded_failure)
     monkeypatch.setattr(report_mod, "run_collectives_pass",
                         clean_collectives)
+    monkeypatch.setattr(report_mod, "run_cost_pass", clean_cost)
     clean = tmp_path / "clean.py"
     clean.write_text("x = 1\n")
     rep = report_mod.run_all(paths=[str(clean)], baseline_path="")

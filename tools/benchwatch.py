@@ -55,6 +55,11 @@ HEADLINE_KEYS: Tuple[Tuple[str, str, str], ...] = (
     ("serve.slo.premium_p99_ratio", "x", "lower"),
     ("serve.cache.amplification", "x", "higher"),
     ("obs.overhead_pct", "%", "lower"),
+    # ISSUE 14: the cost observatory's measured step MFU (flops ÷ run_s ÷
+    # platform peak) — the headline the "45% MFU" verdict becomes as a
+    # number. Missing in pre-cost rounds → n/a per the benchwatch
+    # contract; direction: higher is better.
+    ("cost.step_mfu_pct", "%", "higher"),
     ("nullinv_s_per_image", "s/image", "lower"),
 )
 

@@ -1,10 +1,11 @@
 """jaxcheck — the repo's static analyzer (docs/STATIC_ANALYSIS.md).
 
-Three passes over the stack, one exit code:
+Four passes over the stack, one exit code:
 
     python tools/jaxcheck.py                  # all passes, full report
     python tools/jaxcheck.py --ast-only       # milliseconds: lints only
     python tools/jaxcheck.py --only collectives  # just the shardcheck pass
+    python tools/jaxcheck.py --only cost      # cost cards vs frozen budgets
     python tools/jaxcheck.py --json out.json  # structured report for CI
     python tools/jaxcheck.py --fix            # mechanical fixes in place
     python tools/jaxcheck.py --update-baseline  # accept current findings
@@ -43,11 +44,13 @@ def main(argv=None) -> int:
                     help="skip the traced-program passes (no jax import; "
                          "milliseconds) — shorthand for --only ast")
     ap.add_argument("--only", default=None,
-                    choices=("ast", "contracts", "collectives"),
+                    choices=("ast", "contracts", "collectives", "cost"),
                     help="run a single report section: 'ast' (pass 1), "
                          "'contracts' (jaxpr contracts + compile-key "
-                         "sweep), or 'collectives' (the shardcheck pass "
-                         "alone — fast local iteration on mesh programs)")
+                         "sweep), 'collectives' (the shardcheck pass "
+                         "alone — fast local iteration on mesh programs), "
+                         "or 'cost' (the cost observatory's canonical "
+                         "cards vs the frozen tools/cost_budgets.json)")
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help="baseline file (default: tools/"
                          "jaxcheck_baseline.json; '' disables)")
@@ -81,11 +84,11 @@ def main(argv=None) -> int:
         # never lints would silently wipe the file.
         ap.error("--update-baseline needs the AST pass (drop --only, or "
                  "use --only ast)")
-    if args.paths and args.only in ("contracts", "collectives"):
+    if args.paths and args.only in ("contracts", "collectives", "cost"):
         # Honored-flags discipline: lint targets would be silently unread.
         ap.error(f"lint targets only apply to the AST pass; "
                  f"--only {args.only} takes none")
-    if args.fix and args.only in ("contracts", "collectives"):
+    if args.fix and args.only in ("contracts", "collectives", "cost"):
         # --fix rewrites lint targets and re-lints them; a run that never
         # lints would rewrite files whose state the report never reflects.
         ap.error(f"--fix needs the AST pass (drop --only {args.only})")
@@ -162,6 +165,8 @@ def main(argv=None) -> int:
                     report["content_key"]["ok"]]
         if "collectives" in report:
             oks.append(report["collectives"]["ok"])
+        if "cost" in report:
+            oks.append(report["cost"]["ok"])
         report["ok"] = all(oks)
 
     print(report_mod.render_text(report, verbose=args.verbose))

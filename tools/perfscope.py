@@ -1,0 +1,221 @@
+"""perfscope — the per-program cost/roofline observatory CLI (ISSUE 14).
+
+The tool-derived form of the PERF.md arithmetic: XLA cost cards
+(``cost_analysis``/``memory_analysis``) for every canonical program,
+roofline classification and model-predicted ms against the per-platform
+peak table, measured MFU, and the frozen-budget diff the quality gate's
+``cost_regression`` leg enforces.
+
+    python tools/perfscope.py                  # canonical cards + roofline
+    python tools/perfscope.py --headline       # reproduce the PERF.md MFU
+                                               # arithmetic from recorded
+                                               # artifacts alone
+    python tools/perfscope.py --check-budgets  # diff vs tools/cost_budgets
+                                               # .json (the CI leg); exit 1
+                                               # names drifted programs
+    python tools/perfscope.py --update-budgets # freeze the current cards
+                                               # (deliberate regeneration)
+    python tools/perfscope.py --programs F     # render a serve
+                                               # --programs-out artifact
+    python tools/perfscope.py --json out.json  # structured report
+
+``--headline`` recomputes "89 TF/s ≈ 45% MFU at 40.75 ms/step" from the
+committed artifacts only: per-step FLOPs + measured ms/step recorded in
+``tools/cost_budgets.json``'s ``headline`` block (provenance: the round-5
+on-chip ``cost_analysis()`` capture), peaks from the platform table —
+no hand arithmetic anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def headline(budgets: dict) -> dict:
+    """The PERF.md headline MFU arithmetic off recorded artifacts: per-step
+    FLOPs and measured ms/step from the budgets' ``headline`` block,
+    peaks from the platform table. Returns the derived numbers."""
+    from p2p_tpu.obs import costmodel
+
+    head = budgets["headline"]
+    peaks = costmodel.lookup_peaks(head["platform"])
+    if peaks is None:
+        raise ValueError(f"no peak-table entry for platform "
+                         f"{head['platform']!r}")
+    flops = float(head["flops_per_step"])
+    ms = float(head["measured_ms_per_step"])
+    mfu = costmodel.mfu_pct(flops, ms, peaks)
+    return {
+        "program": head["program"],
+        "platform": head["platform"],
+        "flops_per_step": flops,
+        "measured_ms_per_step": ms,
+        "tf_per_s": flops / (ms / 1e3) / 1e12,
+        "peak_tf_per_s": peaks.flops_per_s / 1e12,
+        "mfu_pct": mfu,
+        "predicted_ms_at_peak": flops / peaks.flops_per_s * 1e3,
+        "source": head.get("source", ""),
+    }
+
+
+def render_headline(h: dict) -> str:
+    return (f"{h['program']} on {h['platform']}: "
+            f"{h['tf_per_s']:.1f} TF/s ≈ {h['mfu_pct']:.1f}% MFU "
+            f"at {h['measured_ms_per_step']:.2f} ms/step "
+            f"(peak {h['peak_tf_per_s']:.0f} TF/s; "
+            f"{h['flops_per_step'] / 1e12:.2f} TF/step; "
+            f"compute floor {h['predicted_ms_at_peak']:.1f} ms/step)")
+
+
+def render_cards(cards: dict, peaks) -> str:
+    from p2p_tpu.obs import costmodel
+
+    lines = [f"peaks: {peaks.flops_per_s / 1e12:.3f} TF/s, "
+             f"{peaks.bytes_per_s / 1e9:.2f} GB/s "
+             f"({peaks.platform}, {peaks.source}; "
+             f"ridge {peaks.ridge:.1f} flops/byte)",
+             f"  {'program':26s} {'flops':>12s} {'bytes':>12s} "
+             f"{'int.':>6s} {'bound':>9s} {'pred ms':>8s}"]
+    for name in sorted(cards):
+        c = cards[name]
+        roof = costmodel.roofline(c["flops"], c["bytes_accessed"], peaks)
+        lines.append(
+            f"  {name:26s} {c['flops']:>12.4g} "
+            f"{c['bytes_accessed']:>12.4g} "
+            f"{roof['arith_intensity']:>6.2f} {roof['bound']:>9s} "
+            f"{roof['predicted_ms']:>8.2f}")
+    return "\n".join(lines)
+
+
+def render_programs(entries: list) -> str:
+    lines = [f"  {'program':40s} {'flops':>12s} {'bytes':>12s} "
+             f"{'bound':>9s} {'pred ms':>8s} {'disp':>5s} "
+             f"{'run ms':>8s} {'MFU%':>6s}"]
+    for e in entries:
+        mfu = e.get("mean_mfu_pct")
+        lines.append(
+            f"  {e['program'][:40]:40s} {e['flops']:>12.4g} "
+            f"{e['bytes_accessed']:>12.4g} {e.get('bound', '?'):>9s} "
+            f"{e.get('predicted_ms', 0.0):>8.2f} "
+            f"{e.get('dispatches', 0):>5d} "
+            f"{e.get('mean_run_ms', 0.0):>8.2f} "
+            f"{'-' if mfu is None else f'{mfu:.1f}':>6s}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--headline", action="store_true",
+                    help="reproduce the PERF.md headline MFU arithmetic "
+                         "from the recorded artifacts alone")
+    ap.add_argument("--check-budgets", action="store_true",
+                    help="diff the canonical cost cards against the "
+                         "frozen budgets; exit 1 naming drifted programs "
+                         "(the quality-gate cost_regression leg)")
+    ap.add_argument("--update-budgets", action="store_true",
+                    help="rewrite the frozen canonical budgets from the "
+                         "current cards (a DELIBERATE cost change, "
+                         "reviewed like a golden regen)")
+    ap.add_argument("--programs", default=None, metavar="FILE",
+                    help="render a serve --programs-out JSONL artifact "
+                         "instead of compiling the canonical programs")
+    ap.add_argument("--budgets", default=None, metavar="FILE",
+                    help="budgets file (default: tools/cost_budgets.json)")
+    ap.add_argument("--json", default=None, metavar="FILE",
+                    help="also write the structured report here")
+    args = ap.parse_args(argv)
+
+    # Honored-flags discipline (same as jaxcheck): a mode that would
+    # silently ignore another requested action is a usage error, never a
+    # quiet no-op — `--update-budgets --headline` must not print a
+    # headline and leave the operator believing the budgets re-froze.
+    if args.update_budgets and args.check_budgets:
+        ap.error("--update-budgets conflicts with --check-budgets "
+                 "(freeze or verify, not both)")
+    if args.headline and (args.update_budgets or args.check_budgets):
+        ap.error("--headline is a read-only report; it cannot run with "
+                 "--update-budgets/--check-budgets")
+    if args.programs and (args.headline or args.update_budgets
+                          or args.check_budgets):
+        ap.error("--programs renders a recorded artifact; it takes none "
+                 "of --headline/--check-budgets/--update-budgets")
+
+    report: dict = {}
+    rc = 0
+
+    if args.programs:
+        entries = []
+        with open(args.programs) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    entries.append(json.loads(line))
+        print(f"{len(entries)} program cost card(s) from {args.programs}")
+        print(render_programs(entries))
+        report["programs"] = entries
+    else:
+        # Everything below needs the package; pin the deterministic CPU
+        # backend exactly like the other analyzer drivers.
+        from p2p_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform()
+
+        from p2p_tpu.obs import costmodel
+
+        budgets_path = args.budgets or os.path.join(
+            _REPO, costmodel.DEFAULT_BUDGETS)
+        budgets = costmodel.load_budgets(budgets_path)
+
+        if args.headline:
+            h = headline(budgets)
+            print(render_headline(h))
+            report["headline"] = h
+        else:
+            cards = costmodel.canonical_cost_cards()
+            report["cards"] = cards
+            peaks = costmodel.detect_peaks()
+            report["peaks"] = peaks.to_dict()
+            print(render_cards(cards, peaks))
+            if args.update_budgets:
+                budgets["programs"] = {
+                    name: {f: cards[name][f]
+                           for f in costmodel.BUDGET_FIELDS}
+                    for name in sorted(cards)}
+                with open(budgets_path, "w") as f:
+                    json.dump(budgets, f, indent=2)
+                    f.write("\n")
+                print(f"budgets updated: {budgets_path} "
+                      f"({len(cards)} program(s) frozen)")
+            elif args.check_budgets:
+                verdicts = costmodel.check_budgets(cards, budgets)
+                bad = [v for v in verdicts if not v.ok]
+                for v in verdicts:
+                    if not v.ok:
+                        print(v.format())
+                report["budget"] = [v.to_dict() for v in verdicts]
+                if bad:
+                    names = sorted({v.program for v in bad})
+                    print(f"COST REGRESSION: {', '.join(names)} "
+                          f"(deliberate change? python tools/perfscope.py "
+                          f"--update-budgets)")
+                    rc = 1
+                else:
+                    print(f"cost budgets hold "
+                          f"({len(verdicts)} check(s) clean)")
+
+    if args.json:
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"wrote {args.json}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,19 +36,29 @@ def unet_scan(params, x, ctx):
     out, _ = jax.lax.scan(body, x, jnp.arange(50, dtype=jnp.int32))
     return out
 
-# FLOPs of a single forward
+# FLOPs of a single forward — via the shared cost-observatory helper
+# (obs/costmodel.py), which owns the dict-vs-list cost_analysis() API-drift
+# guard and the memory_analysis() byte budget.
+from p2p_tpu.obs import costmodel
+
 single = jax.jit(lambda p, x, c: apply_unet(p, cfg.unet, x, jnp.int32(1), c, layout=layout)[0])
-lowered = single.lower(params, x, ctx)
-compiled = lowered.compile()
-ca = compiled.cost_analysis()
-flops = ca.get("flops", 0.0) if isinstance(ca, dict) else ca[0]["flops"]
-print(f"single fwd flops (batch {B}): {flops/1e12:.3f} TF", flush=True)
+card = costmodel.card_from_compiled(single.lower(params, x, ctx).compile(),
+                                    program=f"unet_step_b{B}")
+flops = card.flops
+peaks = costmodel.detect_peaks()
+roof = costmodel.roofline(card.flops, card.bytes_accessed, peaks)
+print(f"single fwd flops (batch {B}): {flops/1e12:.3f} TF; "
+      f"{card.bytes_accessed/1e9:.2f} GB accessed; {roof['bound']}-bound, "
+      f"predicted {roof['predicted_ms']:.1f} ms/step at "
+      f"{peaks.platform} peaks ({peaks.source})", flush=True)
 
 t0 = time.perf_counter(); r = np.asarray(unet_scan(params, x, ctx)); print(f"unet_scan compile {time.perf_counter()-t0:.1f}s", flush=True)
 for _ in range(2):
     t0 = time.perf_counter(); r = np.asarray(unet_scan(params, x, ctx)); dt = time.perf_counter()-t0
+    mfu = costmodel.mfu_pct(flops, dt / 50 * 1000.0, peaks)
     print(f"unet 50-step scan: {dt*1000:.0f} ms -> {dt/50*1000:.2f} ms/step, "
-          f"{flops*50/dt/1e12:.1f} TF/s", flush=True)
+          f"{flops*50/dt/1e12:.1f} TF/s"
+          + (f" = {mfu:.1f}% MFU" if mfu is not None else ""), flush=True)
 
 # VAE decode timing (f32, as the pipeline runs it)
 vparams = vae_mod.init_vae(jax.random.PRNGKey(2), cfg.vae)

@@ -483,7 +483,11 @@ def _static_analysis():
     the analyzer CLI and its own tests."""
     from p2p_tpu.analysis import report as report_mod
 
-    report = report_mod.run_all(buckets=(1,), collective_dps=(2,))
+    # The cost pass runs as the gate's own `cost_regression` leg (below),
+    # so the canonical programs compile once per gate run, not twice.
+    report = report_mod.run_all(buckets=(1,), collective_dps=(2,),
+                                sections=("ast", "contracts",
+                                          "collectives"))
     new = report["ast"]["summary"]["new"]
     contract_fails = [r for r in report["contracts"]["results"] if not r.ok]
     # Compile-key and content-key sweeps share the verdict line: both are
@@ -507,6 +511,22 @@ def _static_analysis():
             + len(report["content_key"]["fields"]),
             len(key_fails), len(report["collectives"]["results"]),
             len(shard_fails), shard_bytes, detail)
+
+
+def _cost_regression(pipe, budgets_path=None):
+    """The cost-observatory budget contract (ISSUE 14): compile the
+    canonical serve programs, extract their XLA cost cards
+    (``obs.costmodel``) and diff the frozen fields (flops, bytes
+    accessed) against ``tools/cost_budgets.json``. A refactor that
+    silently doubles a canonical program's bytes accessed fails here *by
+    program name* — the same frozen-artifact discipline jaxcheck applies
+    to compile keys and collectives. Returns the verdict list."""
+    from p2p_tpu.obs import costmodel
+
+    cards = costmodel.canonical_cost_cards(pipe)
+    budgets = costmodel.load_budgets(
+        budgets_path or os.path.join(_REPO, costmodel.DEFAULT_BUDGETS))
+    return costmodel.check_budgets(cards, budgets)
 
 
 def main(argv=None) -> int:
@@ -568,6 +588,16 @@ def main(argv=None) -> int:
                          "restart cycles with bounded disk/RSS/fd/thread "
                          "invariants (fake runners, ~1 min); also "
                          "reachable as --only soak")
+    ap.add_argument("--skip-cost", action="store_true",
+                    help="skip the cost_regression check (ISSUE 14; "
+                         "~20s: compile the canonical serve programs and "
+                         "diff their XLA cost cards against the frozen "
+                         "tools/cost_budgets.json)")
+    ap.add_argument("--cost-budgets", default=None, metavar="FILE",
+                    help="budgets file for cost_regression (default: "
+                         "tools/cost_budgets.json; the override exists "
+                         "so the verdict-flip drill can gate against a "
+                         "perturbed copy)")
     ap.add_argument("--skip-static", action="store_true",
                     help="skip the static-analysis check (ISSUE 5 + 11; "
                          "~90s: AST lints + traced-program contracts + "
@@ -591,13 +621,14 @@ def main(argv=None) -> int:
                                        "obs_overhead", "fault_drill",
                                        "static_analysis", "flight_parity",
                                        "bench_trend", "lifecycle", "soak",
-                                       "mesh_parity", "slo", "cache_parity"}
+                                       "mesh_parity", "slo", "cache_parity",
+                                       "cost_regression"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
                      f"valid: {', '.join(cases)}, phase_gate, serve_parity, "
                      f"obs_overhead, fault_drill, static_analysis, "
                      f"flight_parity, bench_trend, lifecycle, soak, "
-                     f"mesh_parity, slo, cache_parity")
+                     f"mesh_parity, slo, cache_parity, cost_regression")
 
     drifted = []
     for name, fn in cases.items():
@@ -815,6 +846,19 @@ def main(argv=None) -> int:
                   f"{max(res['disk_bytes_per_cycle'])}B, rss +"
                   f"{res['rss_growth_kb']}kB, {res['snapshots_total']} "
                   f"snapshots ok")
+
+    if not args.skip_cost and (only is None or "cost_regression" in only):
+        verdicts = _cost_regression(pipe, budgets_path=args.cost_budgets)
+        bad = [v for v in verdicts if not v.ok]
+        names = sorted({v.program for v in bad})
+        print(f"{'cost_regression':16s} {len(bad)}/{len(verdicts)} frozen "
+              f"cost-budget violation(s)"
+              + (f" in {', '.join(names)}" if names else "")
+              + f" {'ok' if not bad else 'DRIFT'}")
+        for v in bad:
+            print("  " + v.format())
+        if bad:
+            drifted.append("cost_regression")
 
     if not args.skip_static and (only is None or "static_analysis" in only):
         (ok, new, n_contracts, bad_contracts, n_fields, bad_fields,
