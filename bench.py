@@ -620,7 +620,8 @@ def _measure(preset):
                 lambda x: jnp.broadcast_to(x, (g,) + x.shape), ctrl)
 
         def run_batched(g, ctrls, seed, steps=num_steps, scheduler="ddim",
-                        bpipe=None, bprompts=None, gate=None):
+                        bpipe=None, bprompts=None, gate=None,
+                        schedule=None):
             # Prompt encoding stays inside the timed region, matching
             # what text2image times for the single-group variant. Guidance
             # always comes from the pipe's config (sweep's 7.5 default only
@@ -635,6 +636,7 @@ def _measure(preset):
                                 bpipe.latent_shape, dtype=dtype)
             imgs, _ = sweep(bpipe, ctx, lats, ctrls, num_steps=steps,
                             scheduler=scheduler, mesh=None, gate=gate,
+                            schedule=schedule,
                             guidance_scale=bpipe.config.guidance_scale)
             return np.asarray(imgs)
 
@@ -740,6 +742,57 @@ def _measure(preset):
                 p2_ms = (t_gated * 1000.0 - gate_step * p1_ms) / p2_steps
                 extras["phase1_ms_per_step"] = round(p1_ms, 2)
                 extras["phase2_ms_per_step"] = round(max(p2_ms, 0.0), 2)
+
+            # ISSUE 15: the SEARCHED per-site reuse schedule — the
+            # committed artifact (tools/schedules/default_v1.json, the
+            # schedule-search winner) run on the same operating point.
+            # Recorded as the nested `gate.schedule` sub-record so the
+            # trajectory (and benchwatch's `gate.schedule.speedup`
+            # headline, higher=better) can split the generalized-schedule
+            # win from the single-gate one. The drift side of the claim is
+            # the quality gate's `schedule` leg; this block records speed.
+            import json as _json
+
+            from p2p_tpu.engine.reuse import resolve_schedule
+            from p2p_tpu.models.config import unet_layout as _ulayout
+            from p2p_tpu.ops import schedulers as _sched_mod
+
+            art = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "tools", "schedules", "default_v1.json")
+            with open(art) as f:
+                spec = _json.load(f)
+            sched_rate = timed(lambda s, c=ctrls: run_batched(
+                g, c, s, schedule=spec)) * imgs_per_run
+            scan = int(_sched_mod.schedule_from_config(
+                num_steps, pipe.config.scheduler,
+                kind="ddim").timesteps.shape[0])
+            resolved = resolve_schedule(spec, _ulayout(pipe.config.unet),
+                                        scan, controller)
+            sub = {
+                "artifact": "tools/schedules/default_v1.json",
+                "imgs_per_s": round(sched_rate, 4),
+                "cfg_gate_step": resolved.cfg_gate,
+                "sites_cached": resolved.sites_cached(),
+                "cached_site_steps_fraction": round(
+                    resolved.cached_site_steps_fraction(), 4),
+                "search_speedup": (spec.get("provenance") or {}).get(
+                    "measured_speedup"),
+            }
+            # Mean ms/step of the scheduled run — the honestly-measurable
+            # per-step fact. The gate block's derived phase split does NOT
+            # generalize here: its arithmetic assumes phase 1 costs the
+            # ungated rate, and a schedule removes compute from phase 1
+            # too (sites reused while CFG is live), so a derived split
+            # would be systematically fictitious, not noisy.
+            sub["ms_per_step"] = round(
+                imgs_per_run / sched_rate / num_steps * 1000.0, 2)
+            if full_rate:
+                # Speedup over the UNGATED baseline at the same operating
+                # point — the ISSUE 15 ≥1.5× target — plus the single-gate
+                # ladder rung for the PERF.md ladder.
+                sub["speedup"] = round(sched_rate / full_rate, 4)
+                sub["uniform_gate_speedup"] = round(rate / full_rate, 4)
+            extras["gate"] = {"schedule": sub}
 
         # Quality-matched secondary: DPM-Solver++(2M) at 20 steps reaches
         # ~50-step-DDIM quality (PERF.md) — the practical operating point.

@@ -78,6 +78,14 @@ VARIANTS: Dict[str, Tuple[object, dict]] = {
     "guidance": (3.0, {}),
     "negative_prompt": ("blurry", {}),
     "gate": (0.5, {}),
+    # ISSUE 15: a NON-uniform reuse schedule (a uniform one would
+    # normalize onto the plain gate and be a deliberate no-op). At the
+    # base's steps=3 this resolves to cfg_gate=2 with one early cross
+    # flip and the self sites inherited from step 2 — segmented
+    # programs, so the jaxpr fingerprint moves with the key.
+    "schedule": ({"cfg_gate": 2,
+                  "cross": {"*": 2, "cross_attn/down1": 1},
+                  "self": {"*": 2}}, {}),
     "arrival_ms": (125.0, {}),
     "deadline_ms": (5000.0, {}),
     "priority": (3, {}),
@@ -125,6 +133,18 @@ def _request(overrides: dict):
     return Request(**{**BASE, **overrides})
 
 
+def _overrides_key(overrides: dict) -> Tuple:
+    """Hashable fingerprint-cache key for an override set — JSON-object
+    values (the ``schedule`` spec) canonicalize through a sorted dump."""
+    import json
+
+    def canon(v):
+        return (json.dumps(v, sort_keys=True)
+                if isinstance(v, (dict, list)) else v)
+
+    return tuple(sorted((k, canon(v)) for k, v in overrides.items()))
+
+
 def _program_fingerprint(pipe, prep) -> str:
     """Hash of the serve batch program this prepared request would compile
     (bucket 1 — bucket only scales the group axis, per-field identity is
@@ -157,7 +177,8 @@ def _program_fingerprint(pipe, prep) -> str:
     def run(up, vp, ctx, lat, ctrl, gs):
         return _sweep_jit(up, vp, cfg, layout, schedule, req.scheduler,
                           ctx, lat, ctrl, gs, None, progress=False,
-                          gate=prep.gate_step, metrics=False)
+                          gate=prep.gate_step, metrics=False,
+                          reuse=prep.schedule)
 
     jaxpr = jax.make_jaxpr(run)(pipe.unet_params, pipe.vae_params, ctx,
                                 lat, ctrl, gs)
@@ -178,6 +199,21 @@ PHASE_VARIANT_OVERRIDES: Dict[str, Tuple[object, dict]] = {
     # but not its key would poison the pool cache.
     "steps": (5, {}),
     "gate": (0.75, {}),
+    # ISSUE 15: under the gated phase base the schedule comparison runs
+    # schedule-vs-schedule (gate and schedule are mutually exclusive, so
+    # the extras swap the base's gate for an equivalent-boundary
+    # schedule). Base and variant differ ONLY in WHICH cross site flips
+    # early — a phase-1-only cell: the phase-1 program and key must both
+    # move, while the phase-2 view of both collapses to the uniform
+    # table (key component None) and the phase-2 program stays put —
+    # the projection-correctness regression for the split keys.
+    "schedule": ({"cfg_gate": 2,
+                  "cross": {"*": 2, "cross_attn/down3": 1},
+                  "self": {"*": None}},
+                 {"gate": None,
+                  "schedule": {"cfg_gate": 2,
+                               "cross": {"*": 2, "cross_attn/down1": 1},
+                               "self": {"*": None}}}),
 }
 
 
@@ -212,10 +248,23 @@ def _phase_fingerprints(pipe, prep) -> Tuple[str, str]:
         lambda x: jnp.stack([x]), prep.controller))
     gs = jnp.float32(req.guidance)
 
+    # Mirror the pool runners exactly: each phase program is keyed (and
+    # traced) with its PROJECTED schedule component from the split key —
+    # None (plain gate) when the view collapsed to the uniform table.
+    from ..engine.reuse import ReuseSchedule
+
+    def view_sched(phase_key):
+        skey = phase_key[-1]
+        return None if skey is None else ReuseSchedule.from_key(skey)
+
+    reuse1 = view_sched(prep.phase1_key)
+    reuse2 = view_sched(prep.phase2_key)
+
     def run1(up, ctx, lat, ctrl, gs):
         return _sweep_phase1_jit(up, cfg, layout, schedule, req.scheduler,
                                  ctx, lat, ctrl, gs, progress=False,
-                                 gate=prep.gate_step, metrics=False)
+                                 gate=prep.gate_step, metrics=False,
+                                 reuse=reuse1)
 
     fp1 = jax.make_jaxpr(run1)(pipe.unet_params, ctx, lat, ctrl, gs)
 
@@ -232,7 +281,7 @@ def _phase_fingerprints(pipe, prep) -> Tuple[str, str]:
         return _sweep_phase2_jit(up, vp, cfg, layout, schedule,
                                  req.scheduler, ctx_c, carry, ctrl, gs,
                                  progress=False, gate=prep.gate_step,
-                                 metrics=False)
+                                 metrics=False, reuse=reuse2)
 
     fp2 = jax.make_jaxpr(run2)(pipe.unet_params, pipe.vae_params,
                                cond[None], carry, p2_g, gs)
@@ -281,7 +330,7 @@ def check_phase_keys(pipe=None,
         prep = prepare(_request({**PHASE_EXTRA, **overrides}), pipe)
         assert prep.gated, ("phase-key sweep base must stay gated; "
                             f"overrides {overrides} ungated it")
-        cache_key = tuple(sorted(overrides.items()))
+        cache_key = _overrides_key(overrides)
         if cache_key not in fp_cache:
             fp_cache[cache_key] = _phase_fingerprints(pipe, prep)
         return fp_cache[cache_key], key1_fn(prep), key2_fn(prep)
@@ -335,7 +384,7 @@ def check_compile_key(pipe=None,
 
     def fingerprint(overrides: dict):
         prep = prepare(_request(overrides), pipe)
-        cache_key = tuple(sorted(overrides.items()))
+        cache_key = _overrides_key(overrides)
         if cache_key not in fp_cache:
             fp_cache[cache_key] = _program_fingerprint(pipe, prep)
         return fp_cache[cache_key], key_fn(prep)
@@ -380,6 +429,11 @@ OUTPUT_DETERMINING: Dict[str, bool] = {
     "guidance": True,
     "negative_prompt": True,
     "gate": True,
+    # ISSUE 15: a (non-uniform) reuse schedule changes which site-steps
+    # compute — different images. Keyed on the RESOLVED table, so specs
+    # resolving identically (and the uniform table vs plain gate=g)
+    # share a cache line.
+    "schedule": True,
     "request_id": False,
     "arrival_ms": False,
     "deadline_ms": False,

@@ -190,7 +190,7 @@ def _stage_dp(x, mesh):
     return jax.device_put(x, NamedSharding(mesh, P("dp")))
 
 
-def _trace_sweep(pipe, ctrl, bucket, gate, metrics, mesh=None):
+def _trace_sweep(pipe, ctrl, bucket, gate, metrics, mesh=None, reuse=None):
     import jax
     import jax.numpy as jnp
 
@@ -215,15 +215,17 @@ def _trace_sweep(pipe, ctrl, bucket, gate, metrics, mesh=None):
     def run(up, vp, ctx_g, lat_g, ctrl_g, gs):
         return _sweep_jit(up, vp, cfg, layout, schedule, "ddim", ctx_g,
                           lat_g, ctrl_g, gs, None, progress=False,
-                          gate=gate, metrics=metrics)
+                          gate=gate, metrics=metrics, reuse=reuse)
 
     return jax.make_jaxpr(run)(pipe.unet_params, pipe.vae_params, ctx_g,
                                lat_g, ctrl_g, gs)
 
 
-def _zero_carry(pipe, ctrl):
+def _zero_carry(pipe, ctrl, reuse=None):
     """A zero-valued per-group PhaseCarry with the shapes the phase-1 pool
-    program produces for this controller — the phase-2 pool trace input."""
+    program produces for this controller — the phase-2 pool trace input.
+    ``reuse`` (a resolved reuse schedule, ISSUE 15) swaps the all-cross
+    AttnCache for the schedule's ever-cached leaf set."""
     import jax.numpy as jnp
 
     from ..controllers.base import init_store_state
@@ -237,14 +239,22 @@ def _zero_carry(pipe, ctrl):
     lat = jnp.zeros((b,) + pipe.latent_shape)
     state = (init_store_state(layout, b)
              if (ctrl is not None and ctrl.needs_store) else ())
+    if reuse is not None:
+        from ..engine import reuse as reuse_mod
+
+        cache = reuse_mod.init_schedule_cache(layout, reuse, b, phase=2,
+                                              dtype=lat.dtype)
+    else:
+        cache = init_attn_cache(layout, b, dtype=lat.dtype)
     return PhaseCarry(
         latents=lat, resid=jnp.zeros_like(lat),
-        cache=init_attn_cache(layout, b, dtype=lat.dtype),
+        cache=cache,
         ms=sched_mod.init_multistep_state("ddim", lat.shape, lat.dtype),
         state=state)
 
 
-def _trace_sweep_phase1(pipe, ctrl, bucket, gate, metrics, mesh=None):
+def _trace_sweep_phase1(pipe, ctrl, bucket, gate, metrics, mesh=None,
+                        reuse=None):
     import jax
     import jax.numpy as jnp
 
@@ -269,12 +279,13 @@ def _trace_sweep_phase1(pipe, ctrl, bucket, gate, metrics, mesh=None):
     def run(up, ctx_g, lat_g, ctrl_g, gs):
         return _sweep_phase1_jit(up, cfg, layout, schedule, "ddim", ctx_g,
                                  lat_g, ctrl_g, gs, progress=False,
-                                 gate=gate, metrics=metrics)
+                                 gate=gate, metrics=metrics, reuse=reuse)
 
     return jax.make_jaxpr(run)(pipe.unet_params, ctx_g, lat_g, ctrl_g, gs)
 
 
-def _trace_sweep_phase2(pipe, ctrl, bucket, gate, metrics, mesh=None):
+def _trace_sweep_phase2(pipe, ctrl, bucket, gate, metrics, mesh=None,
+                        reuse=None):
     import jax
     import jax.numpy as jnp
 
@@ -288,7 +299,7 @@ def _trace_sweep_phase2(pipe, ctrl, bucket, gate, metrics, mesh=None):
     schedule = sched_mod.schedule_from_config(STEPS, cfg.scheduler,
                                               kind="ddim")
     cond = encode_prompts(pipe, list(PROMPTS))
-    carry = _zero_carry(pipe, ctrl)
+    carry = _zero_carry(pipe, ctrl, reuse=reuse)
     p2 = phase2_controller(ctrl)
 
     def lead(x):
@@ -308,7 +319,7 @@ def _trace_sweep_phase2(pipe, ctrl, bucket, gate, metrics, mesh=None):
     def run(up, vp, ctx_g, carry_g, ctrl_g, gs):
         return _sweep_phase2_jit(up, vp, cfg, layout, schedule, "ddim",
                                  ctx_g, carry_g, ctrl_g, gs, progress=False,
-                                 gate=gate, metrics=metrics)
+                                 gate=gate, metrics=metrics, reuse=reuse)
 
     return jax.make_jaxpr(run)(pipe.unet_params, pipe.vae_params, ctx_g,
                                carry_g, ctrl_g, gs)
@@ -416,6 +427,72 @@ def canonical_programs(pipe=None, buckets=(1, 2, 4, 8),
                             metrics=metrics))
     programs.append(Program("invert/null_text", null, group_batch=1,
                             gate=None, metrics=metrics))
+    return programs
+
+
+def scheduled_programs(pipe=None, spec=None, buckets=(1,),
+                       metrics=False) -> List[Program]:
+    """Scheduled canonical programs (ISSUE 15): the committed default
+    reuse-schedule artifact (or ``spec``) resolved at the canonical
+    STEPS, traced as the monolithic serve program and the two pool
+    programs — the quality gate's ``schedule`` leg runs the no-f64 and
+    hot-scan-callback contracts over these, so a schedule that sneaks a
+    host callback or an f64 promotion into a segment fails CI exactly
+    like a canonical program would. The spec is resolved with a
+    NON-uniform fallback: if the artifact happens to normalize to the
+    uniform gate at this scan length, the trace would silently collapse
+    onto already-covered programs, so that case raises instead."""
+    import jax
+
+    from ..engine import reuse as reuse_mod
+    from ..models.config import unet_layout
+
+    if pipe is None:
+        pipe = tiny_pipeline()
+    if spec is None:
+        import json
+        import os
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            "tools", "schedules", "default_v1.json")
+        with open(path) as f:
+            spec = json.load(f)
+    b = len(PROMPTS)
+    ctrl = _edit_controller(pipe)
+    layout = unet_layout(pipe.config.unet)
+    sched = reuse_mod.resolve_schedule(spec, layout, STEPS, ctrl)
+    if sched.uniform_gate is not None:
+        raise ValueError(
+            f"schedule spec resolves to the uniform gate at {STEPS} scan "
+            "steps — the scheduled contract sweep would trace nothing new")
+    gate = sched.cfg_gate
+    programs = []
+    import warnings
+
+    with warnings.catch_warnings():
+        # Window-conflict warnings are the workload's business (the tiny
+        # contract controller has a long edit window on purpose); the
+        # contract sweep only cares about program structure.
+        warnings.simplefilter("ignore")
+        for g in buckets:
+            programs.append(Program(
+                f"serve/sched-bucket{g}",
+                _trace_sweep(pipe, ctrl, bucket=g, gate=gate,
+                             metrics=metrics, reuse=sched),
+                group_batch=b, gate=gate, metrics=metrics, lead_dims=(g,)))
+            programs.append(Program(
+                f"serve/sched-phase1-bucket{g}",
+                _trace_sweep_phase1(pipe, ctrl, bucket=g, gate=gate,
+                                    metrics=metrics,
+                                    reuse=reuse_mod.phase1_view(sched)),
+                group_batch=b, gate=gate, metrics=metrics, lead_dims=(g,)))
+            programs.append(Program(
+                f"serve/sched-phase2-bucket{g}",
+                _trace_sweep_phase2(pipe, ctrl, bucket=g, gate=gate,
+                                    metrics=metrics,
+                                    reuse=reuse_mod.phase2_view(sched)),
+                group_batch=b, gate=gate, metrics=metrics, lead_dims=(g,)))
     return programs
 
 

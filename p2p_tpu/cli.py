@@ -137,6 +137,25 @@ def controller_from_opts(prompts, tokenizer, num_steps, *, mode,
     )
 
 
+def _schedule_spec(args):
+    """Load the ``--schedule`` artifact (a reuse-schedule JSON spec) for
+    the sampling subcommands; fail fast — before the model build — on a
+    bad file or a ``--gate`` conflict (the schedule IS a generalized
+    gate)."""
+    path = getattr(args, "schedule", None)
+    if path is None:
+        return None
+    if getattr(args, "gate", None) is not None:
+        raise SystemExit("--gate and --schedule are mutually exclusive: "
+                         "the schedule's cfg_gate is the gate")
+    from .engine.reuse import load_spec
+
+    try:
+        return load_spec(path)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"--schedule {path}: {e}")
+
+
 def _make_controller(args, prompts, tokenizer, num_steps):
     return controller_from_opts(
         prompts, tokenizer, num_steps, mode=args.mode,
@@ -152,6 +171,7 @@ def cmd_generate(args) -> int:
 
     from .utils.progress import trace
 
+    sched_spec = _schedule_spec(args)
     pipe = _build_pipeline(args)
 
     def out_path(seed):
@@ -169,7 +189,8 @@ def cmd_generate(args) -> int:
             imgs, _ = sweep(pipe, ctx, lats, None, num_steps=args.steps,
                             guidance_scale=args.guidance,
                             scheduler=args.scheduler, mesh=mesh,
-                            gate=args.gate, progress=not args.quiet,
+                            gate=args.gate, schedule=sched_spec,
+                            progress=not args.quiet,
                             metrics=met)
             for i, seed in enumerate(args.seeds):
                 _save(np.asarray(imgs[i][0]), out_path(seed))
@@ -183,7 +204,7 @@ def cmd_generate(args) -> int:
                                    scheduler=args.scheduler,
                                    rng=jax.random.PRNGKey(seed),
                                    negative_prompt=args.negative_prompt,
-                                   gate=args.gate,
+                                   gate=args.gate, schedule=sched_spec,
                                    progress=not args.quiet, metrics=met)
             _save(np.asarray(img[0]), out_path(seed))
     return 0
@@ -246,6 +267,7 @@ def _edit_batched(args, pipe, prompts, controller, out_dir,
                                    args.negative_prompt)
     kw = dict(num_steps=args.steps, guidance_scale=args.guidance,
               scheduler=args.scheduler, mesh=mesh, gate=args.gate,
+              schedule=_schedule_spec(args),
               progress=not args.quiet, metrics=metrics)
     base_imgs, _ = sweep(pipe, ctx, lats, None, **kw)
     ctrls = jax.tree_util.tree_map(
@@ -283,6 +305,7 @@ def cmd_edit(args) -> int:
     from .models.config import unet_layout
 
     layout = unet_layout(pipe.config.unet)
+    sched_spec = _schedule_spec(args)
     with _metrics_session(args.metrics) as met, trace(args.profile):
         for seed in args.seeds:
             rng = jax.random.PRNGKey(seed)
@@ -291,7 +314,7 @@ def cmd_edit(args) -> int:
                                       guidance_scale=args.guidance,
                                       scheduler=args.scheduler, rng=rng,
                                       negative_prompt=args.negative_prompt,
-                                      gate=args.gate,
+                                      gate=args.gate, schedule=sched_spec,
                                       progress=not args.quiet, layout=layout,
                                       metrics=met)
             img, _, store = text2image(pipe, prompts, controller,
@@ -299,7 +322,7 @@ def cmd_edit(args) -> int:
                                        guidance_scale=args.guidance,
                                        scheduler=args.scheduler, latent=x_t,
                                        negative_prompt=args.negative_prompt,
-                                       gate=args.gate,
+                                       gate=args.gate, schedule=sched_spec,
                                        progress=not args.quiet, layout=layout,
                                        metrics=met,
                                        return_store=bool(args.attn_maps
@@ -503,6 +526,7 @@ def cmd_serve(args) -> int:
         from .obs import costmodel as obs_costmodel
 
         costscope = obs_costmodel.CostScope()
+    default_sched = _schedule_spec(args)
     pipe = _build_pipeline(args)
     stream = sys.stdin if args.requests == "-" else open(args.requests)
     items = []
@@ -512,8 +536,18 @@ def cmd_serve(args) -> int:
                 item = parse_jsonl_line(line)
             except (ValueError, KeyError) as e:
                 raise SystemExit(f"--requests line {i + 1}: {e}")
-            if item is not None:
-                items.append(item)
+            if item is None:
+                continue
+            if default_sched is not None and isinstance(item, Request) \
+                    and item.gate is None and item.schedule is None:
+                # The server default applies only where the request left
+                # BOTH knobs unset: an explicit per-request gate or
+                # schedule always wins (and gate+schedule stays a clean
+                # per-request schema reject).
+                import dataclasses as _dc
+
+                item = _dc.replace(item, schedule=default_sched)
+            items.append(item)
     prewarm = None
     if not args.no_prewarm:
         # Compile-ahead with the first request as the representative shape:
@@ -800,6 +834,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "controller's edit-window end); 0.5 gates at "
                              "half the steps; an integer is an absolute "
                              "step. Omit for exact (ungated) sampling")
+        sp.add_argument("--schedule", default=None, metavar="FILE",
+                        help="per-site per-step reuse schedule artifact "
+                             "(JSON, e.g. tools/schedules/default_v1.json):"
+                             " the generalized gate — each attention site "
+                             "flips to cached/inherited reuse at its own "
+                             "step. Mutually exclusive with --gate")
 
     def edit_opts(sp):
         sp.add_argument("--mode", choices=("replace", "refine"),
@@ -920,6 +960,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "gated requests run their monolithic program in "
                         "one pool (the pre-disaggregation engine; the A/B "
                         "baseline bench.py compares against)")
+    s.add_argument("--schedule", default=None, metavar="FILE",
+                   help="default per-site reuse schedule artifact (JSON, "
+                        "e.g. tools/schedules/default_v1.json) applied to "
+                        "every request that sets neither 'gate' nor its "
+                        "own 'schedule' field; per-request schedules "
+                        "override (docs/SERVING.md)")
     s.add_argument("--queue-cap", type=int, default=64,
                    help="admission bound on outstanding requests; beyond "
                         "it, requests are rejected with a reason "

@@ -235,6 +235,31 @@ def controller_step_window(controller: Optional["Controller"],
     return min(end, num_steps)
 
 
+def controller_edit_windows(controller: Optional["Controller"],
+                            num_steps: int) -> Tuple[int, int]:
+    """Host-side: the per-kind edit-window ends ``(cross_end, self_end)``
+    — the last scan step (exclusive) at which the controller can still
+    modify CROSS-attention maps vs SELF-attention maps.
+
+    :func:`controller_step_window` is the max of these (plus the
+    SpatialReplace horizon, which is a latent-space hook and constrains
+    neither attention kind); the per-site reuse-schedule conflict check
+    (``engine.reuse.warn_schedule_conflicts``) needs the split so a
+    self-site reuse inside only the *cross* window doesn't warn."""
+    if controller is None or controller.is_identity \
+            or controller.edit is None:
+        return 0, 0
+    import numpy as np
+
+    ca = np.asarray(controller.edit.cross_alpha)
+    step_axis = ca.ndim - 5
+    other = tuple(i for i in range(ca.ndim) if i != step_axis)
+    nz = np.nonzero(np.any(ca != 0, axis=other))[0]
+    cross_end = int(nz[-1]) + 1 if nz.size else 0
+    self_end = int(np.max(np.asarray(controller.edit.self_end)))
+    return min(cross_end, num_steps), min(self_end, num_steps)
+
+
 StoreState = Tuple[jax.Array, ...]
 
 

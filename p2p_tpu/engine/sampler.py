@@ -195,6 +195,37 @@ def resolve_gate(gate, num_scan_steps: int,
     return g
 
 
+def resolve_reuse(gate, schedule, layout, num_scan: int,
+                  controller: Optional[Controller] = None):
+    """Resolve the (``gate``, ``schedule``) pair every sampling surface
+    accepts into ``(gate_step, reuse_or_None)``.
+
+    ``schedule`` is a reuse-schedule spec (JSON dict), an already-resolved
+    ``engine.reuse.ReuseSchedule``, or None. The two knobs are mutually
+    exclusive — a schedule IS a generalized gate. A schedule that resolves
+    to the UNIFORM table normalizes to a plain gate step (``reuse=None``):
+    it is then bitwise-identical to — and compiles/pools as — today's
+    ``gate=g`` program. Non-uniform schedules return the static table; the
+    per-site window-conflict warning fires here (the generalized
+    ``warn_gate_truncation``)."""
+    if schedule is None:
+        return resolve_gate(gate, num_scan, controller), None
+    if gate is not None:
+        raise ValueError("gate and schedule are mutually exclusive: a "
+                         "reuse schedule generalizes the gate (its "
+                         "cfg_gate is the phase boundary)")
+    from . import reuse as reuse_mod
+
+    sched = reuse_mod.resolve_schedule(schedule, layout, num_scan,
+                                       controller)
+    u = sched.uniform_gate
+    if u is not None:
+        warn_gate_truncation(u, num_scan, controller)
+        return u, None
+    reuse_mod.warn_schedule_conflicts(sched, layout, controller, num_scan)
+    return sched.cfg_gate, sched
+
+
 def warn_gate_truncation(gate_step: int, num_scan: int,
                          controller: Optional[Controller]) -> None:
     """Warn when an explicit gate changes controller semantics: truncating
@@ -286,6 +317,172 @@ def _make_ms_step(schedule: sched_mod.DiffusionSchedule, scheduler_kind: str):
     return ms_step
 
 
+def _make_scheduled_body(
+    unet_params: Any,
+    cfg: PipelineConfig,
+    layout: AttnLayout,
+    schedule: sched_mod.DiffusionSchedule,
+    scheduler_kind: str,
+    context: jax.Array,
+    b: int,
+    controller: Optional[Controller],
+    guidance_scale: jax.Array,
+    emit: bool,
+    progress: bool,
+    sp: Optional["SpConfig"],
+    *,
+    cfg_active: bool,
+    site_plan: Tuple[str, ...],
+    resid_const: Optional[jax.Array] = None,
+    state_const: Tuple = (),
+):
+    """One reuse-schedule SEGMENT's scan body (engine.reuse): the per-site
+    action vector ``site_plan`` is constant over the segment, so each
+    segment compiles as one ``lax.scan``.
+
+    ``cfg_active`` segments run the CFG-doubled U-Net with full controller
+    hooks at computed sites, capturing the guidance residual each step —
+    the latent math of ``_make_phase1_body(capture=True)``. Past the CFG
+    boundary the body is the single-branch extrapolation of
+    ``_phase2_scan``'s ``body2`` (``resid_const``/``state_const`` are the
+    frozen hand-off values), with the cache riding the carry so sites that
+    flip to reuse *inside* phase 2 can keep storing until their step."""
+    ms_step = _make_ms_step(schedule, scheduler_kind)
+
+    def body(carry, scan_in):
+        step, t = scan_in
+        if cfg_active:
+            latents, state, ms, cache, resid = carry
+            progress_mod.emit_step(emit, step, phase="phase1",
+                                   report=progress)
+            latent_in = jnp.concatenate([latents] * 2, axis=0)
+            eps, state, cache = apply_unet(
+                unet_params, cfg.unet, latent_in, t, context,
+                layout=layout, controller=controller, state=state,
+                step=step, sp=sp, attn_cache=cache, site_plan=site_plan)
+            eps_uncond, eps_text = eps[:b], eps[b:]
+            resid = eps_text - eps_uncond
+            eps = eps_uncond + guidance_scale * resid
+            eps = sched_mod.to_epsilon(schedule, eps, t, latents)
+            ms, latents = ms_step(ms, eps, t, latents)
+            latents = apply_step_callback(controller, layout, state,
+                                          latents, step)
+            return (latents, state, ms, cache, resid), None
+        latents, ms, cache = carry
+        progress_mod.emit_step(emit, step, phase="phase2", report=progress)
+        eps_text, _, cache = apply_unet(
+            unet_params, cfg.unet, latents, t, context,
+            layout=layout, controller=None, state=(), step=step, sp=sp,
+            attn_cache=cache, site_plan=site_plan)
+        eps = eps_text + (guidance_scale - 1.0) * resid_const
+        eps = sched_mod.to_epsilon(schedule, eps, t, latents)
+        ms, latents = ms_step(ms, eps, t, latents)
+        latents = apply_step_callback(controller, layout, state_const,
+                                      latents, step)
+        return (latents, ms, cache), None
+
+    return body
+
+
+def _scheduled_phase1(
+    unet_params: Any,
+    cfg: PipelineConfig,
+    layout: AttnLayout,
+    schedule: sched_mod.DiffusionSchedule,
+    scheduler_kind: str,
+    context: jax.Array,            # (2B, L, D) [uncond; cond]
+    latents: jax.Array,            # (B, h, w, c)
+    controller: Optional[Controller],
+    guidance_scale: jax.Array,
+    *,
+    reuse,                         # engine.reuse.ReuseSchedule (static)
+    progress: bool = False,
+    metrics: bool = False,
+    sp: Optional["SpConfig"] = None,
+) -> PhaseCarry:
+    """The generalized phase-1 executor: steps ``[0, cfg_gate)`` under full
+    CFG, cut into constant-plan segments (engine.reuse.segments). Sites
+    whose reuse step falls inside this range flip to their cache
+    mid-phase; the rest capture exactly like ``_phase1_scan``. Returns the
+    :class:`PhaseCarry` with full-batch leaves sliced to the cond half —
+    the same hand-off pytree the uniform gate produces, just with the
+    schedule's leaf set."""
+    from . import reuse as reuse_mod
+
+    sched1 = reuse_mod.phase1_view(reuse)
+    emit = progress or metrics
+    b = latents.shape[0]
+    state = (init_store_state(layout, b, dtype=jnp.float32)
+             if (controller is not None and controller.needs_store) else ())
+    ms_state = sched_mod.init_multistep_state(scheduler_kind, latents.shape,
+                                              latents.dtype)
+    num_scan = schedule.timesteps.shape[0]
+    assert sched1.steps == num_scan, (sched1.steps, num_scan)
+    steps = jnp.arange(num_scan, dtype=jnp.int32)
+    cache = reuse_mod.init_schedule_cache(layout, sched1, b, phase=1,
+                                          dtype=latents.dtype)
+    resid = jnp.zeros_like(latents)
+    carry = (latents, state, ms_state, cache, resid)
+    for seg in reuse_mod.segments(layout, sched1, phase=1):
+        body = _make_scheduled_body(unet_params, cfg, layout, schedule,
+                                    scheduler_kind, context, b, controller,
+                                    guidance_scale, emit, progress, sp,
+                                    cfg_active=True, site_plan=seg.plan)
+        carry, _ = jax.lax.scan(
+            body, carry,
+            (steps[seg.start:seg.stop],
+             schedule.timesteps[seg.start:seg.stop]))
+    latents, state, ms_state, cache, resid = carry
+    cache = reuse_mod.slice_cache_to_cond(layout, sched1, cache, b)
+    return PhaseCarry(latents=latents, resid=resid, cache=cache,
+                      ms=ms_state, state=state)
+
+
+def _scheduled_phase2(
+    unet_params: Any,
+    cfg: PipelineConfig,
+    layout: AttnLayout,
+    schedule: sched_mod.DiffusionSchedule,
+    scheduler_kind: str,
+    context_cond: jax.Array,       # (B, L, D) — the uncond half is GONE
+    carry: PhaseCarry,
+    controller: Optional[Controller],
+    guidance_scale: jax.Array,
+    *,
+    reuse,                         # engine.reuse.ReuseSchedule (static)
+    progress: bool = False,
+    metrics: bool = False,
+    sp: Optional["SpConfig"] = None,
+) -> jax.Array:
+    """The generalized phase-2 executor: steps ``[cfg_gate, S)`` off a
+    :class:`PhaseCarry`, segmented so sites may keep computing
+    single-branch past the CFG boundary and flip to reuse at their own
+    step (their cache slots keep storing until then). The uniform table —
+    every cross site reused from the boundary — reduces to exactly one
+    segment with every cross site in ``use``: ``_phase2_scan``'s body."""
+    from . import reuse as reuse_mod
+
+    sched2 = reuse_mod.phase2_view(reuse)
+    emit = progress or metrics
+    num_scan = schedule.timesteps.shape[0]
+    assert sched2.steps == num_scan, (sched2.steps, num_scan)
+    steps = jnp.arange(num_scan, dtype=jnp.int32)
+    c2 = (carry.latents, carry.ms, carry.cache)
+    for seg in reuse_mod.segments(layout, sched2, phase=2):
+        body = _make_scheduled_body(unet_params, cfg, layout, schedule,
+                                    scheduler_kind, context_cond,
+                                    context_cond.shape[0], controller,
+                                    guidance_scale, emit, progress, sp,
+                                    cfg_active=False, site_plan=seg.plan,
+                                    resid_const=carry.resid,
+                                    state_const=carry.state)
+        c2, _ = jax.lax.scan(
+            body, c2,
+            (steps[seg.start:seg.stop],
+             schedule.timesteps[seg.start:seg.stop]))
+    return c2[0]
+
+
 def _make_phase1_body(
     unet_params: Any,
     cfg: PipelineConfig,
@@ -370,12 +567,24 @@ def _phase1_scan(
     progress: bool = False,
     metrics: bool = False,
     sp: Optional["SpConfig"] = None,
+    reuse=None,                    # engine.reuse.ReuseSchedule (static)
 ) -> PhaseCarry:
     """Scan steps ``[0, gate)`` with full CFG + controller hooks, capturing
     every cross-attention output and the CFG residual. Returns the
     :class:`PhaseCarry` a phase-2 program continues from. Latent math is
     identical to the ungated body (the capture only adds carry writes), so
-    phase-1 latents match the baseline bitwise."""
+    phase-1 latents match the baseline bitwise.
+
+    ``reuse`` (a non-uniform ``engine.reuse.ReuseSchedule``) generalizes
+    the gate: the scan is segmented so sites flip to their caches at their
+    own steps (``_scheduled_phase1``). A uniform table routes back here —
+    bitwise the PR-1 program by construction."""
+    if reuse is not None and reuse.uniform_gate is None:
+        assert reuse.cfg_gate == gate, (reuse.cfg_gate, gate)
+        return _scheduled_phase1(unet_params, cfg, layout, schedule,
+                                 scheduler_kind, context, latents,
+                                 controller, guidance_scale, reuse=reuse,
+                                 progress=progress, metrics=metrics, sp=sp)
     emit = progress or metrics
     b = latents.shape[0]
     state = (init_store_state(layout, b, dtype=jnp.float32)
@@ -413,13 +622,22 @@ def _phase2_scan(
     progress: bool = False,
     metrics: bool = False,
     sp: Optional["SpConfig"] = None,
+    reuse=None,                    # engine.reuse.ReuseSchedule (static)
 ) -> jax.Array:
     """Scan steps ``[gate, S)`` off a :class:`PhaseCarry`: single-branch
     U-Net (no uncond batch half), guidance as a fixed extrapolation off the
     captured residual (SD-Acc), cross-attention served from the cache
     (TAD). ``controller`` here is the phase-2 slice
     (:func:`phase2_controller` for pooled serving; the monolithic path
-    passes the full controller — both emit identical ops)."""
+    passes the full controller — both emit identical ops). ``reuse`` (a
+    non-uniform schedule) segments the scan per the table
+    (``_scheduled_phase2``)."""
+    if reuse is not None and reuse.uniform_gate is None:
+        assert reuse.cfg_gate == gate, (reuse.cfg_gate, gate)
+        return _scheduled_phase2(unet_params, cfg, layout, schedule,
+                                 scheduler_kind, context_cond, carry,
+                                 controller, guidance_scale, reuse=reuse,
+                                 progress=progress, metrics=metrics, sp=sp)
     emit = progress or metrics
     ms_step = _make_ms_step(schedule, scheduler_kind)
     cache, resid, state = carry.cache, carry.resid, carry.state
@@ -469,6 +687,7 @@ def _denoise_scan(
     sp: Optional["SpConfig"] = None,
     gate: Optional[int] = None,    # static: first phase-2 scan step; None/S = off
     metrics: bool = False,         # static: trace the telemetry callback in
+    reuse=None,                    # engine.reuse.ReuseSchedule (static)
 ) -> Tuple[jax.Array, StoreState]:
     """Scan over timesteps. Returns (final latents, final store state).
 
@@ -497,6 +716,36 @@ def _denoise_scan(
     emit = progress or metrics
     b = latents.shape[0]
     num_scan = schedule.timesteps.shape[0]
+    if reuse is not None:
+        # Per-site per-step reuse schedule (engine.reuse, ISSUE 15). The
+        # UNIFORM table is semantically gate=cfg_gate: normalize onto the
+        # gate path below, so it is bitwise-identical by construction. A
+        # non-uniform table runs the segmented executors — whose uniform
+        # reduction is additionally pinned bitwise-equal by
+        # tests/test_schedule.py (the generalization proof).
+        u = reuse.uniform_gate
+        if u is not None:
+            gate = u if gate is None else gate
+            assert gate == u, (gate, u)
+            reuse = None
+        else:
+            if uncond_per_step is not None:
+                raise ValueError(
+                    "reuse schedules cannot run under per-step null-text "
+                    "uncond embeddings (validated upstream)")
+            carry = _scheduled_phase1(
+                unet_params, cfg, layout, schedule, scheduler_kind,
+                context, latents, controller, guidance_scale, reuse=reuse,
+                progress=progress, metrics=metrics, sp=sp)
+            if reuse.cfg_gate >= num_scan:
+                # CFG never drops: the whole scan ran in the (segmented)
+                # CFG phase; cached sites still saved their compute.
+                return carry.latents, carry.state
+            latents = _scheduled_phase2(
+                unet_params, cfg, layout, schedule, scheduler_kind,
+                context[b:], carry, controller, guidance_scale,
+                reuse=reuse, progress=progress, metrics=metrics, sp=sp)
+            return latents, carry.state
     if gate is None:
         gate = num_scan
     assert 1 <= gate <= num_scan, (gate, num_scan)
@@ -548,7 +797,7 @@ def _denoise_scan(
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
                                    "return_store", "progress", "sp", "gate",
-                                   "metrics"))
+                                   "metrics", "reuse"))
 def _text2image_jit(
     unet_params: Any,
     vae_params: Any,
@@ -567,12 +816,13 @@ def _text2image_jit(
     sp: Optional["SpConfig"] = None,
     gate: Optional[int] = None,
     metrics: bool = False,
+    reuse=None,
 ):
     context = jnp.concatenate([context_uncond, context_cond], axis=0)
     latents, state = _denoise_scan(
         unet_params, cfg, layout, schedule, scheduler_kind, context, latents,
         controller, guidance_scale, uncond_per_step, progress=progress, sp=sp,
-        gate=gate, metrics=metrics)
+        gate=gate, metrics=metrics, reuse=reuse)
     image = vae_mod.decode(vae_params, cfg.vae, latents.astype(jnp.float32))
     image = vae_mod.to_uint8(image)
     return (image, latents, state) if return_store else (image, latents, ())
@@ -597,6 +847,7 @@ def text2image(
     sp: Optional["SpConfig"] = None,
     gate=None,
     metrics: bool = False,
+    schedule=None,
 ):
     """Generate an edit group of images from prompts under attention control —
     the `/root/reference/ptp_utils.py:129-172` entry point.
@@ -621,6 +872,16 @@ def text2image(
     branch at *every* step, so truncating it would silently misalign the
     replay — rejected with an error instead. Returns
     ``(images uint8 (B,H,W,3), x_T, store)``.
+
+    ``schedule`` (mutually exclusive with ``gate``) is a per-site per-step
+    reuse schedule — a spec dict (``engine.reuse.validate_spec``; the CLI
+    loads ``--schedule FILE`` artifacts like
+    ``tools/schedules/default_v1.json``) or an already-resolved
+    ``engine.reuse.ReuseSchedule``. Each attention site flips from
+    computing to serving its cached cross-attention output (TAD) or
+    inherited self-attention feature (A-SDM) at its own step;
+    ``cfg_gate`` plays the gate's role for the CFG branch. The uniform
+    table normalizes onto the exact ``gate=g`` program (bitwise).
 
     ``metrics`` enables device-side telemetry (docs/OBSERVABILITY.md):
     phase-tagged step callbacks are traced into the program and the resolved
@@ -656,10 +917,11 @@ def text2image(
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    schedule = sched_mod.schedule_from_config(num_steps, cfg.scheduler,
-                                              kind=scheduler)
-    num_scan = schedule.timesteps.shape[0]
-    gate_step = resolve_gate(gate, num_scan, controller)
+    tsched = sched_mod.schedule_from_config(num_steps, cfg.scheduler,
+                                            kind=scheduler)
+    num_scan = tsched.timesteps.shape[0]
+    gate_step, reuse_sched = resolve_reuse(gate, schedule, layout, num_scan,
+                                           controller)
     if gate_step < num_scan and uncond_embeddings is not None:
         # The null-text window spans every step (validated (T,1,L,D)
         # above): any gate < T truncates inside it. Reject loudly — a
@@ -670,14 +932,24 @@ def text2image(
             f"{num_scan} steps: CFG truncation would drop the optimized "
             "uncond branch mid-window. Run null-text replays with "
             "gate=None.")
-    warn_gate_truncation(gate_step, num_scan, controller)
+    if reuse_sched is not None and uncond_embeddings is not None:
+        # A non-uniform schedule reroutes per-site features even when its
+        # cfg_gate keeps CFG alive: the per-step optimized uncond would
+        # replay against a different trajectory — same loud rejection.
+        raise ValueError(
+            "schedule conflicts with per-step null-text "
+            "uncond_embeddings: cached/inherited sites change the "
+            "trajectory the uncond branch was optimized against. Run "
+            "null-text replays with schedule=None.")
+    if reuse_sched is None:
+        warn_gate_truncation(gate_step, num_scan, controller)
     context_cond = encode_prompts(pipe, prompts, dtype=dtype)
     context_uncond = encode_prompts(
         pipe, [negative_prompt or ""] * len(prompts), dtype=dtype)
 
     x_t, latents = init_latent(latent, pipe.latent_shape, rng, len(prompts), dtype)
     if progress:
-        progress_mod.activate(schedule.timesteps.shape[0])
+        progress_mod.activate(tsched.timesteps.shape[0])
     if metrics:
         # Host-side run descriptors for the snapshot: the gate decomposition
         # (per-phase ms/step arrives via the step callbacks) plus the CFG
@@ -700,8 +972,8 @@ def text2image(
         # when the caller materializes the arrays) — it marks the host
         # region for Perfetto alignment, not device wall time.
         image, latents_out, state = _text2image_jit(
-            pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
+            pipe.unet_params, pipe.vae_params, cfg, layout, tsched,
             scheduler, context_cond, context_uncond, latents, controller, gs,
             uncond_embeddings, return_store, progress=progress, sp=sp,
-            gate=gate_step, metrics=metrics)
+            gate=gate_step, metrics=metrics, reuse=reuse_sched)
     return image, x_t, state

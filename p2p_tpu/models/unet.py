@@ -214,7 +214,8 @@ class _HookCtx:
                  state: StoreState, step: jax.Array,
                  sp: Optional["SpConfig"] = None,
                  attn_cache: Optional[AttnCache] = None,
-                 cache_mode: str = "off"):
+                 cache_mode: str = "off",
+                 site_plan: Optional[Tuple[str, ...]] = None):
         self.layout = layout
         self.controller = controller
         self.state = state
@@ -223,6 +224,11 @@ class _HookCtx:
         self.cursor = 0
         self.attn_cache = attn_cache
         self.cache_mode = cache_mode
+        # Per-site action vector (engine.reuse): one mode per layout site
+        # in call order — the generalized form the global cache_mode
+        # lowers to. The cache cursor walks the non-"off" sites, whose
+        # leaves the cache tuple holds in the same order.
+        self.site_plan = site_plan
         self.cross_cursor = 0
 
     def next_meta(self):
@@ -277,13 +283,28 @@ def _apply_attention(p: Params, x: jax.Array, context: jax.Array, heads: int,
         return _attention_site(p, x, context, heads, ctx, meta, is_cross)
 
 
+def _site_mode(ctx: _HookCtx, meta, is_cross: bool) -> str:
+    """This site's static cache action. The legacy global ``cache_mode``
+    lowers to the per-site form (all cross sites, no self sites) so both
+    surfaces run ONE code path; ``site_plan`` (engine.reuse schedules) may
+    mix actions per site and cover self sites too."""
+    if ctx.site_plan is not None:
+        return ctx.site_plan[meta.layer_idx]
+    if is_cross and ctx.cache_mode in ("store", "use"):
+        return ctx.cache_mode
+    return "off"
+
+
 def _attention_site(p: Params, x: jax.Array, context: jax.Array, heads: int,
                     ctx: _HookCtx, meta, is_cross: bool) -> jax.Array:
-    if is_cross and ctx.cache_mode == "use":
-        # Phase 2 of gated sampling: the text context is untouched past the
-        # gate, so this site's output is the cached last-phase-1-step tensor.
-        # Returning it here removes q/k/v, softmax(QKᵀ)V and to_out for the
-        # site from the compiled program entirely.
+    mode = _site_mode(ctx, meta, is_cross)
+    if mode == "use":
+        # The site's output is served from its cache: for cross sites the
+        # text context is untouched so the cached tensor is the TAD reuse;
+        # for self sites it is the A-SDM feature inherited from the site's
+        # last computed step. Returning it here removes q/k/v,
+        # softmax(QKᵀ)V and to_out for the site from the compiled program
+        # entirely.
         cached = ctx.attn_cache[ctx.cross_cursor]
         ctx.cross_cursor += 1
         assert cached.shape == (x.shape[0], x.shape[1], x.shape[2]), (
@@ -359,12 +380,21 @@ def _attention_site(p: Params, x: jax.Array, context: jax.Array, heads: int,
 
     out = out.transpose(0, 2, 1, 3).reshape(b, pix, heads * d_head)
     out = nn.linear(p["to_out"], out)
-    if is_cross and ctx.cache_mode == "store":
+    if mode == "store":
         # Capture the conditional half of the CFG-doubled batch (rows B:).
-        # Overwritten every step, so after the phase-1 scan the cache holds
-        # exactly the last phase-1 step's outputs — no per-step select.
+        # Overwritten every step, so after the scan the cache holds
+        # exactly the last stored step's outputs — no per-step select.
         lst = list(ctx.attn_cache)
         lst[ctx.cross_cursor] = out[out.shape[0] // 2:]
+        ctx.attn_cache = tuple(lst)
+        ctx.cross_cursor += 1
+    elif mode == "store_all":
+        # A site that flips to reuse inside its current batch regime
+        # (engine.reuse MODE_STORE_ALL) keeps the whole live batch — 2B
+        # while CFG is active, B past the gate — so the flip segment can
+        # serve it without a shape change.
+        lst = list(ctx.attn_cache)
+        lst[ctx.cross_cursor] = out
         ctx.attn_cache = tuple(lst)
         ctx.cross_cursor += 1
     return out
@@ -411,9 +441,11 @@ def apply_unet(
     sp: Optional[SpConfig] = None,
     attn_cache: Optional[AttnCache] = None,
     cache_mode: str = "off",
+    site_plan: Optional[Tuple[str, ...]] = None,
 ):
     """Predict ε(x_t, t, context). Returns ``(eps, controller_store_state)``,
-    plus the updated cache as a third element iff ``cache_mode='store'``.
+    plus the updated cache as a third element iff ``cache_mode='store'``
+    or a ``site_plan`` is given.
 
     With ``controller=None`` this is a plain conditional U-Net forward and the
     returned state is the input state — the `EmptyControl ≡ no controller`
@@ -434,7 +466,32 @@ def apply_unet(
                          "(expected 'off', 'store' or 'use')")
     if layout is None:
         layout = unet_layout(cfg)
-    if cache_mode != "off":
+    if site_plan is not None:
+        # The per-site generalization (engine.reuse schedules): a static
+        # action per layout site. Mutually exclusive with the legacy
+        # global switch — a caller mixing both has a bug.
+        if cache_mode != "off":
+            raise ValueError("site_plan and cache_mode are mutually "
+                             "exclusive; the plan subsumes the mode")
+        if len(site_plan) != len(layout.metas):
+            raise ValueError(
+                f"site_plan has {len(site_plan)} entries for a layout "
+                f"with {len(layout.metas)} attention sites")
+        bad = set(site_plan) - {"off", "store", "store_all", "use"}
+        if bad:
+            raise ValueError(f"unknown site_plan mode(s) {sorted(bad)}")
+        n_cached = sum(1 for m in site_plan if m != "off")
+        if (attn_cache is None and n_cached) or \
+                (attn_cache is not None and len(attn_cache) != n_cached):
+            raise ValueError(
+                f"site_plan has {n_cached} cached site(s); attn_cache has "
+                f"{None if attn_cache is None else len(attn_cache)} "
+                "leaf/leaves")
+        # Edits at a reused site are structurally impossible (no
+        # probability tensor): schedule resolution warns about window
+        # conflicts upstream (engine.reuse.warn_schedule_conflicts), so
+        # here a controller may legitimately coexist with "use" sites.
+    elif cache_mode != "off":
         n_cross = sum(1 for m in layout.metas if m.is_cross)
         if attn_cache is None or len(attn_cache) != n_cross:
             raise ValueError(
@@ -456,7 +513,8 @@ def apply_unet(
     if step is None:
         step = jnp.int32(0)
     ctx = _HookCtx(layout, controller, state, step, sp=sp,
-                   attn_cache=attn_cache, cache_mode=cache_mode)
+                   attn_cache=attn_cache, cache_mode=cache_mode,
+                   site_plan=site_plan)
     g = cfg.groups
 
     t = jnp.broadcast_to(jnp.asarray(t), (x.shape[0],))
@@ -497,6 +555,6 @@ def apply_unet(
 
     h = nn.silu(nn.group_norm(params["norm_out"], h, g))
     eps = nn.conv2d(params["conv_out"], h)
-    if cache_mode == "store":
+    if cache_mode == "store" or site_plan is not None:
         return eps, ctx.state, ctx.attn_cache
     return eps, ctx.state

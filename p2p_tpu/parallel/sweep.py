@@ -23,15 +23,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..controllers.base import AttnLayout, Controller
 from ..engine.sampler import (PhaseCarry, _denoise_scan, _phase1_scan,
-                              _phase2_scan, resolve_gate, stage_host,
-                              warn_gate_truncation)
+                              _phase2_scan, resolve_gate, resolve_reuse,
+                              stage_host, warn_gate_truncation)
 from ..models import vae as vae_mod
 from ..models.config import PipelineConfig
 from ..ops import schedulers as sched_mod
 
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
-                                   "progress", "gate", "metrics"),
+                                   "progress", "gate", "metrics", "reuse"),
          donate_argnums=())
 def _sweep_jit(
     unet_params: Any,
@@ -48,6 +48,7 @@ def _sweep_jit(
     progress: bool = False,
     gate: Optional[int] = None,
     metrics: bool = False,
+    reuse=None,
 ):
     def one_group(ctx, lat, ctrl, ups):
         # The scanned step index is vmap-invariant (built inside the scan,
@@ -57,7 +58,7 @@ def _sweep_jit(
         lat, state = _denoise_scan(
             unet_params, cfg, layout, schedule, scheduler_kind, ctx, lat, ctrl,
             guidance_scale, uncond_per_step=ups, progress=progress, gate=gate,
-            metrics=metrics)
+            metrics=metrics, reuse=reuse)
         image = vae_mod.decode(vae_params, cfg.vae, lat.astype(jnp.float32))
         return vae_mod.to_uint8(image), lat
 
@@ -111,6 +112,7 @@ def sweep(
     gate=None,
     metrics: bool = False,
     lower_only: bool = False,
+    schedule=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Run G independent edit groups; shard the group axis over ``dp``.
 
@@ -164,18 +166,30 @@ def sweep(
             raise ValueError(
                 f"uncond_per_step has {uncond_per_step.shape[1]} steps, "
                 f"sampling uses {num_steps}")
-    schedule = sched_mod.schedule_from_config(num_steps, cfg.scheduler,
-                                              kind=scheduler)
-    gate_step = resolve_gate(gate, schedule.timesteps.shape[0], controllers)
-    if gate_step < schedule.timesteps.shape[0] and uncond_per_step is not None:
+    tsched = sched_mod.schedule_from_config(num_steps, cfg.scheduler,
+                                            kind=scheduler)
+    num_scan = tsched.timesteps.shape[0]
+    # ``schedule`` (a reuse-schedule spec / resolved table — ISSUE 15)
+    # generalizes ``gate``; resolve_reuse enforces mutual exclusion,
+    # normalizes uniform tables onto the gate path and fires the per-site
+    # window-conflict warning for non-uniform ones.
+    gate_step, reuse_sched = resolve_reuse(gate, schedule, layout, num_scan,
+                                           controllers)
+    if gate_step < num_scan and uncond_per_step is not None:
         raise ValueError(
             f"gate={gate!r} conflicts with per-step null-text uncond "
             "embeddings (active through every step): run null-text replay "
             "sweeps with gate=None")
+    if reuse_sched is not None and uncond_per_step is not None:
+        raise ValueError(
+            "schedule conflicts with per-step null-text uncond embeddings:"
+            " run null-text replay sweeps with schedule=None")
     # Same surfaced semantics as the sequential path: an explicit gate that
     # truncates edit windows / freezes an explicit store must not be
     # silent just because the run is batched.
-    warn_gate_truncation(gate_step, schedule.timesteps.shape[0], controllers)
+    if reuse_sched is None:
+        warn_gate_truncation(gate_step, num_scan, controllers)
+    schedule = tsched
     # Explicit staging when the scale arrives as a host scalar: the serve
     # loop dispatches under jax.transfer_guard("disallow"), where an
     # implicit jnp.asarray(float) h2d would raise (already-on-device values
@@ -190,7 +204,8 @@ def sweep(
             pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
             scheduler, context, latents, controllers,
             np.float32(guidance_scale), uncond_per_step,
-            progress=progress, gate=gate_step, metrics=metrics)
+            progress=progress, gate=gate_step, metrics=metrics,
+            reuse=reuse_sched)
     gs = (guidance_scale if isinstance(guidance_scale, jax.Array)
           else stage_host(np.float32(guidance_scale), mesh=mesh))
 
@@ -218,11 +233,12 @@ def sweep(
         return _sweep_jit(pipe.unet_params, pipe.vae_params, cfg, layout,
                           schedule, scheduler, context, latents, controllers,
                           gs, uncond_per_step, progress=progress,
-                          gate=gate_step, metrics=metrics)
+                          gate=gate_step, metrics=metrics,
+                          reuse=reuse_sched)
 
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
-                                   "progress", "gate", "metrics"),
+                                   "progress", "gate", "metrics", "reuse"),
          donate_argnums=())
 def _sweep_phase1_jit(
     unet_params: Any,
@@ -237,22 +253,26 @@ def _sweep_phase1_jit(
     progress: bool = False,
     gate: int = 1,
     metrics: bool = False,
+    reuse=None,
 ) -> PhaseCarry:
     """The serve layer's phase-1 POOL program: steps ``[0, gate)`` of G
     groups under full CFG + controller hooks, returning the per-group
     :class:`~p2p_tpu.engine.sampler.PhaseCarry` (leaves carry a leading G
     axis) instead of images — no VAE decode, the trajectory continues in a
-    separately scheduled phase-2 program."""
+    separately scheduled phase-2 program. ``reuse`` (a non-uniform
+    ``engine.reuse`` table, static) generalizes the gate: the carry's
+    cache holds the schedule's leaf set instead of all-cross."""
     def one_group(ctx, lat, ctrl):
         return _phase1_scan(unet_params, cfg, layout, schedule,
                             scheduler_kind, ctx, lat, ctrl, guidance_scale,
-                            gate=gate, progress=progress, metrics=metrics)
+                            gate=gate, progress=progress, metrics=metrics,
+                            reuse=reuse)
 
     return jax.vmap(one_group)(context, latents, controllers)
 
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
-                                   "progress", "gate", "metrics"),
+                                   "progress", "gate", "metrics", "reuse"),
          donate_argnums=())
 def _sweep_phase2_jit(
     unet_params: Any,
@@ -268,6 +288,7 @@ def _sweep_phase2_jit(
     progress: bool = False,
     gate: int = 1,
     metrics: bool = False,
+    reuse=None,
 ):
     """The serve layer's phase-2 POOL program: steps ``[gate, S)`` of G
     hand-off carries — single-branch U-Net off the AttnCache, fixed-
@@ -278,7 +299,8 @@ def _sweep_phase2_jit(
     def one_group(ctx_c, car, ctrl):
         lat = _phase2_scan(unet_params, cfg, layout, schedule,
                            scheduler_kind, ctx_c, car, ctrl, guidance_scale,
-                           gate=gate, progress=progress, metrics=metrics)
+                           gate=gate, progress=progress, metrics=metrics,
+                           reuse=reuse)
         image = vae_mod.decode(vae_params, cfg.vae, lat.astype(jnp.float32))
         return vae_mod.to_uint8(image), lat
 
@@ -286,18 +308,23 @@ def _sweep_phase2_jit(
 
 
 def _phase_args(pipe, num_steps: int, scheduler: str, gate,
-                guidance_scale, layout, controllers, mesh=None):
+                guidance_scale, layout, controllers, mesh=None,
+                schedule=None):
     """Shared wrapper plumbing for the two pool entry points: schedule,
     resolved+validated gate (a pool program needs both phases non-empty),
-    staged guidance (replicated over ``mesh`` when given), layout."""
+    staged guidance (replicated over ``mesh`` when given), layout.
+    ``schedule`` is a reuse-schedule spec/table (ISSUE 15): its
+    ``cfg_gate`` is the pool boundary; uniform tables normalize onto the
+    plain gate."""
     cfg = pipe.config
     if layout is None:
         from ..models.config import unet_layout
         layout = unet_layout(cfg.unet)
-    schedule = sched_mod.schedule_from_config(num_steps, cfg.scheduler,
-                                              kind=scheduler)
-    num_scan = schedule.timesteps.shape[0]
-    gate_step = resolve_gate(gate, num_scan, controllers)
+    dsched = sched_mod.schedule_from_config(num_steps, cfg.scheduler,
+                                            kind=scheduler)
+    num_scan = dsched.timesteps.shape[0]
+    gate_step, reuse_sched = resolve_reuse(gate, schedule, layout, num_scan,
+                                           controllers)
     if not 1 <= gate_step < num_scan:
         raise ValueError(
             f"a phase pool program needs a real gate: resolved gate step "
@@ -305,7 +332,7 @@ def _phase_args(pipe, num_steps: int, scheduler: str, gate,
             "requests take the single-pool sweep() path")
     gs = (guidance_scale if isinstance(guidance_scale, jax.Array)
           else stage_host(np.float32(guidance_scale), mesh=mesh))
-    return cfg, layout, schedule, gate_step, gs
+    return cfg, layout, dsched, gate_step, gs, reuse_sched
 
 
 def sweep_phase1(
@@ -323,6 +350,7 @@ def sweep_phase1(
     progress: bool = False,
     metrics: bool = False,
     lower_only: bool = False,
+    schedule=None,
 ) -> PhaseCarry:
     """Run phase 1 of G groups (same shapes/semantics as :func:`sweep`) and
     return the hand-off carry instead of images. ``gate`` must resolve
@@ -331,15 +359,19 @@ def sweep_phase1(
     sharded the same way (the hand-off stays on device).
     ``lower_only=True`` returns the program's ``Lowered`` instead of
     executing (the cost-card path — see :func:`sweep`)."""
-    cfg, layout, schedule, gate_step, gs = _phase_args(
+    cfg, layout, dsched, gate_step, gs, reuse_sched = _phase_args(
         pipe, num_steps, scheduler, gate, guidance_scale, layout,
-        controllers, mesh=mesh)
-    warn_gate_truncation(gate_step, schedule.timesteps.shape[0], controllers)
+        controllers, mesh=mesh, schedule=schedule)
+    if reuse_sched is None:
+        warn_gate_truncation(gate_step, dsched.timesteps.shape[0],
+                             controllers)
+    schedule = dsched
     if lower_only:
         return _sweep_phase1_jit.lower(
             pipe.unet_params, cfg, layout, schedule, scheduler, context,
             latents, controllers, np.float32(guidance_scale),
-            progress=progress, gate=gate_step, metrics=metrics)
+            progress=progress, gate=gate_step, metrics=metrics,
+            reuse=reuse_sched)
     if mesh is not None:
         gspec = NamedSharding(mesh, P("dp"))
         context = _stage_sharded(context, gspec)
@@ -355,7 +387,7 @@ def sweep_phase1(
         return _sweep_phase1_jit(pipe.unet_params, cfg, layout, schedule,
                                  scheduler, context, latents, controllers,
                                  gs, progress=progress, gate=gate_step,
-                                 metrics=metrics)
+                                 metrics=metrics, reuse=reuse_sched)
 
 
 def sweep_phase2(
@@ -373,6 +405,7 @@ def sweep_phase2(
     progress: bool = False,
     metrics: bool = False,
     lower_only: bool = False,
+    schedule=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Finish G hand-off carries: steps ``[gate, S)`` + VAE decode.
     ``controllers`` must already be the phase-2 slice
@@ -384,15 +417,15 @@ def sweep_phase2(
     target shard with an explicit device-to-device ``device_put`` — no
     host round-trip, so the transfer-guard("disallow") contract holds on
     mesh dispatch too. Returns ``(images, final latents)``."""
-    cfg, layout, schedule, gate_step, gs = _phase_args(
+    cfg, layout, schedule, gate_step, gs, reuse_sched = _phase_args(
         pipe, num_steps, scheduler, gate, guidance_scale, layout,
-        controllers, mesh=mesh)
+        controllers, mesh=mesh, schedule=schedule)
     if lower_only:
         return _sweep_phase2_jit.lower(
             pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
             scheduler, context_cond, carry, controllers,
             np.float32(guidance_scale), progress=progress, gate=gate_step,
-            metrics=metrics)
+            metrics=metrics, reuse=reuse_sched)
     if mesh is not None:
         gspec = NamedSharding(mesh, P("dp"))
         context_cond = _stage_sharded(context_cond, gspec)
@@ -409,7 +442,8 @@ def sweep_phase2(
         return _sweep_phase2_jit(pipe.unet_params, pipe.vae_params, cfg,
                                  layout, schedule, scheduler, context_cond,
                                  carry, controllers, gs, progress=progress,
-                                 gate=gate_step, metrics=metrics)
+                                 gate=gate_step, metrics=metrics,
+                                 reuse=reuse_sched)
 
 
 def artifact_replay_inputs(pipe, x_t, uncond_embeddings, source: str,

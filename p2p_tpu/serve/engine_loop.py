@@ -2464,7 +2464,13 @@ def serve_forever(
                     reason=f"server draining ({drain_ctl.reason}); "
                            f"resubmit after restart")
                 continue
+            # Scheduled requests (ISSUE 15) are exempt from the level-1
+            # force-gate: gate and schedule are mutually exclusive at the
+            # schema level, and a reuse schedule already bought its own
+            # cheaper sampling — forcing gate='auto' onto one would be a
+            # clean-schema reject, not a degradation.
             forced_gate = degrade_level >= 1 and item.gate is None and \
+                item.schedule is None and \
                 (slo is None
                  or slo.tier(item) not in slo.protect_gate_tiers)
             if forced_gate:

@@ -178,10 +178,23 @@ def carry_template(pipe, prep):
     ctrl = prep.controller
     state = (init_store_state(layout, b)
              if (ctrl is not None and ctrl.needs_store) else ())
+    sched = getattr(prep, "schedule", None)
+    if sched is not None:
+        # Per-site reuse schedule (ISSUE 15): the hand-off cache holds one
+        # (B, P, C) leaf per EVER-CACHED site of the table (cross or
+        # self), not the all-cross AttnCache of the uniform gate — the
+        # request's schedule determines the spill spec exactly like it
+        # determines the phase programs.
+        from ..engine import reuse as reuse_mod
+
+        cache = reuse_mod.init_schedule_cache(layout, sched, b, phase=2,
+                                              dtype=lat.dtype)
+    else:
+        cache = init_attn_cache(layout, b, dtype=lat.dtype)
     carry = PhaseCarry(
         latents=lat,
         resid=jnp.zeros_like(lat),
-        cache=init_attn_cache(layout, b, dtype=lat.dtype),
+        cache=cache,
         ms=sched_mod.init_multistep_state(prep.request.scheduler, lat.shape,
                                           lat.dtype),
         state=state)

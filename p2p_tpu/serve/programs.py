@@ -123,6 +123,28 @@ class ProgramCache:
                 "hit_rate": (self.hits / total) if total else 0.0}
 
 
+def _reuse_from_key(sched_key: Tuple):
+    """The reuse-schedule table from its compile-key component (or None):
+    the runners rebuild the static table from the key alone, so identical
+    tables from different request files build — and pool as — one
+    program."""
+    if sched_key is None:
+        return None
+    from ..engine.reuse import ReuseSchedule
+
+    return ReuseSchedule.from_key(sched_key)
+
+
+def _reuse_kwargs(gate_step, sched) -> dict:
+    """The gate/schedule pair a runner's program was keyed for — mutually
+    exclusive by construction (``resolve_reuse``), so exactly one is
+    non-None. Shared by every runner class so the dispatch can never
+    diverge between the monolithic and pool paths."""
+    if sched is not None:
+        return {"gate": None, "schedule": sched}
+    return {"gate": gate_step, "schedule": None}
+
+
 class SweepRunner:
     """Default runner: encode + stack + pad one batch, run ``parallel.sweep``.
 
@@ -145,7 +167,8 @@ class SweepRunner:
                  heartbeat: bool = False, mesh=None, semcache=None):
         self.pipe = pipe
         (_, self.steps, self.scheduler, self.gate_step, self.group_batch,
-         _) = compile_key
+         _, sched_key) = compile_key
+        self.sched = _reuse_from_key(sched_key)
         self.bucket = bucket
         self.progress = progress
         self.validate = validate
@@ -237,16 +260,19 @@ class SweepRunner:
         ctx, lat, ctrl = self._inputs(entries, zeros=True)
         return sweep(self.pipe, ctx, lat, ctrl, num_steps=self.steps,
                      guidance_scale=1.0, scheduler=self.scheduler,
-                     mesh=None, gate=self.gate_step,
+                     mesh=None, **self._reuse_kw(),
                      progress=self.progress, metrics=self.heartbeat,
                      lower_only=True)
+
+    def _reuse_kw(self) -> dict:
+        return _reuse_kwargs(self.gate_step, self.sched)
 
     def _run(self, ctx, lat, ctrl, guidance: float):
         from ..parallel import sweep
 
         imgs, lats = sweep(self.pipe, ctx, lat, ctrl, num_steps=self.steps,
                            guidance_scale=guidance, scheduler=self.scheduler,
-                           mesh=self.mesh, gate=self.gate_step,
+                           mesh=self.mesh, **self._reuse_kw(),
                            progress=self.progress, metrics=self.heartbeat)
         return imgs, lats
 
@@ -314,7 +340,7 @@ class Phase1Runner(SweepRunner):
         return sweep_phase1(self.pipe, ctx, lat, ctrl, num_steps=self.steps,
                             guidance_scale=guidance,
                             scheduler=self.scheduler, mesh=self.mesh,
-                            gate=self.gate_step,
+                            **self._reuse_kw(),
                             progress=self.progress, metrics=self.heartbeat)
 
     def cost_lowered(self, entries):
@@ -324,7 +350,7 @@ class Phase1Runner(SweepRunner):
         return sweep_phase1(self.pipe, ctx, lat, ctrl,
                             num_steps=self.steps, guidance_scale=1.0,
                             scheduler=self.scheduler, mesh=None,
-                            gate=self.gate_step, progress=self.progress,
+                            **self._reuse_kw(), progress=self.progress,
                             metrics=self.heartbeat, lower_only=True)
 
     def warm(self, entries) -> None:
@@ -371,7 +397,11 @@ class Phase2Runner:
         # (the hand-off unit already carries the cond context).
         self.pipe = pipe
         (_, _, self.steps, self.scheduler, self.gate_step, self.group_batch,
-         _) = compile_key
+         _, sched_key) = compile_key
+        # The phase-2 PROJECTION of the reuse table (phase2_view rode the
+        # key): schedules differing only before the boundary share this
+        # key — and therefore this program.
+        self.sched = _reuse_from_key(sched_key)
         self.bucket = bucket
         self.progress = progress
         self.validate = validate
@@ -432,13 +462,16 @@ class Phase2Runner:
             carry = jax.tree_util.tree_map(jnp.zeros_like, carry)
         return ctx, carry, ctrl
 
+    def _reuse_kw(self) -> dict:
+        return _reuse_kwargs(self.gate_step, self.sched)
+
     def _run(self, ctx, carry, ctrl, guidance: float):
         from ..parallel.sweep import sweep_phase2
 
         return sweep_phase2(self.pipe, ctx, carry, ctrl,
                             num_steps=self.steps, guidance_scale=guidance,
                             scheduler=self.scheduler, mesh=self.mesh,
-                            gate=self.gate_step,
+                            **self._reuse_kw(),
                             progress=self.progress, metrics=self.heartbeat)
 
     def _template_inputs(self, entries):
@@ -481,7 +514,7 @@ class Phase2Runner:
         return sweep_phase2(self.pipe, ctx, carry, ctrl_g,
                             num_steps=self.steps, guidance_scale=1.0,
                             scheduler=self.scheduler, mesh=None,
-                            gate=self.gate_step, progress=self.progress,
+                            **self._reuse_kw(), progress=self.progress,
                             metrics=self.heartbeat, lower_only=True)
 
     def __call__(self, entries, guidance: float):

@@ -73,7 +73,7 @@ _MODES = ("replace", "refine")
 CONTENT_FIELDS = ("prompt", "target", "mode", "cross_steps", "self_steps",
                   "blend_words", "equalizer", "blend_resolution", "seed",
                   "steps", "scheduler", "guidance", "negative_prompt",
-                  "gate")
+                  "gate", "schedule")
 SCHEDULING_FIELDS = ("request_id", "arrival_ms", "deadline_ms", "priority",
                      "tenant", "tier")
 
@@ -99,6 +99,13 @@ class Request:
     guidance: float = 7.5
     negative_prompt: Optional[str] = None
     gate: Any = None            # None | 'auto' | float fraction | int step
+    # Per-site per-step reuse schedule (ISSUE 15): a JSON spec object
+    # (engine.reuse.validate_spec), the generalized gate — mutually
+    # exclusive with ``gate``. The RESOLVED static table joins the
+    # compile/content keys (identical tables from different files pool;
+    # a one-cell difference splits); the uniform table normalizes onto
+    # the plain gate path and pools with gate=g traffic.
+    schedule: Any = None
     arrival_ms: float = 0.0     # virtual trace time (loadgen / replay)
     deadline_ms: Optional[float] = None  # relative to arrival; None = none
     priority: int = 0           # higher dispatches first (within a tier)
@@ -175,6 +182,15 @@ def _structural_validate(req: Request) -> None:
     if isinstance(req.gate, str) and req.gate != "auto":
         raise ValueError(f"gate must be null, 'auto', a fraction or a step "
                          f"index, got {req.gate!r}")
+    if req.schedule is not None:
+        if req.gate is not None:
+            raise ValueError("gate and schedule are mutually exclusive: a "
+                             "reuse schedule generalizes the gate")
+        from ..engine.reuse import validate_spec
+
+        # Structural (layout-free) validation at admission — resolution
+        # against the model's site layout happens in prepare().
+        validate_spec(req.schedule)
     # Scheduling metadata is validated HERE, at admission, so a bad value
     # is a clean schema reject — never a TypeError inside the queue's sort
     # comparator three stages later (bool is an int subclass and would
@@ -213,7 +229,8 @@ def controller_signature(controller) -> Tuple:
                   for x in leaves))
 
 
-def content_key(req: Request, gate_step: int, model_name: str) -> Tuple:
+def content_key(req: Request, gate_step: int, model_name: str,
+                sched_key: Optional[Tuple] = None) -> Tuple:
     """The semantic-cache address: every output-determining field, nothing
     else (ISSUE 13). Keyed on the *resolved* gate step, not the raw spec —
     ``gate=0.5`` and ``gate=2`` at ``steps=4`` run the identical
@@ -236,9 +253,13 @@ def content_key(req: Request, gate_step: int, model_name: str) -> Tuple:
             (req.target, req.mode, float(req.cross_steps),
              float(req.self_steps), req.blend_words, req.equalizer,
              int(req.blend_resolution)))
+    # ``sched_key`` is the RESOLVED reuse table (engine.reuse key form),
+    # not the raw spec: specs that resolve identically (fraction vs step,
+    # different files) share a cache line, and the uniform table (None
+    # here) shares one with plain gate=g traffic.
     return ("content", model_name, req.prompt, edit, int(req.seed),
             int(req.steps), req.scheduler, float(req.guidance),
-            req.negative_prompt, int(gate_step))
+            req.negative_prompt, int(gate_step), sched_key)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -258,6 +279,11 @@ class PreparedRequest:
     phase2_key: Optional[Tuple] = None
     phase2_batch_key: Optional[Tuple] = None
     content_key: Optional[Tuple] = None
+    #: The resolved reuse table (engine.reuse.ReuseSchedule) — None when
+    #: the request has no schedule or it normalized to the uniform gate.
+    #: The hand-off carry template and the runners read the TABLE from
+    #: here/the keys; the raw spec never leaves the Request.
+    schedule: Any = None
 
     @property
     def gated(self) -> bool:
@@ -276,7 +302,9 @@ def prepare(req: Request, pipe) -> PreparedRequest:
     _structural_validate(req)
 
     from ..cli import controller_from_opts
-    from ..engine.sampler import resolve_gate
+    from ..engine import reuse as reuse_mod
+    from ..engine.sampler import resolve_reuse
+    from ..models.config import unet_layout
     from ..ops import schedulers as sched_mod
 
     controller = None
@@ -291,10 +319,19 @@ def prepare(req: Request, pipe) -> PreparedRequest:
     schedule = sched_mod.schedule_from_config(req.steps, pipe.config.scheduler,
                                               kind=req.scheduler)
     scan_steps = int(schedule.timesteps.shape[0])
-    gate_step = resolve_gate(req.gate, scan_steps, controller)
+    # ``resolve_reuse`` is the same gate/schedule resolution every sampling
+    # surface uses: it rejects gate+schedule, resolves the spec against the
+    # model's site layout, normalizes a UNIFORM table to the plain gate
+    # (``reuse=None`` — pools with gate=g traffic) and fires the per-site
+    # window-conflict warning for non-uniform tables.
+    layout = unet_layout(pipe.config.unet)
+    gate_step, reuse_sched = resolve_reuse(req.gate, req.schedule, layout,
+                                           scan_steps, controller)
+    sched_key = None if reuse_sched is None else reuse_sched.key()
 
     compile_key = (pipe.config.name, req.steps, req.scheduler, gate_step,
-                   len(req.prompts), controller_signature(controller))
+                   len(req.prompts), controller_signature(controller),
+                   sched_key)
     batch_key = compile_key + (float(req.guidance),)
     phase1_key = phase2_key = phase2_batch_key = None
     if gate_step < scan_steps:
@@ -305,11 +342,32 @@ def prepare(req: Request, pipe) -> PreparedRequest:
         # Conservative components (steps AND gate) stay in both keys: the
         # compile-key completeness sweep (analysis.compile_key) guards both
         # directions per field, and a gate change that altered a phase
-        # program without its key would be cache poisoning.
-        phase1_key = ("phase1",) + compile_key
+        # program without its key would be cache poisoning. The SCHEDULE
+        # component is per-phase PROJECTED (engine.reuse.phase{1,2}_view):
+        # a table cell that only moves a phase-1 flip must not split the
+        # phase-2 pool — lanes from schedules differing only before the
+        # boundary still pack into one phase-2 program.
+        # A projection that collapses to the UNIFORM table is the plain
+        # gate=g phase program — its key component normalizes to None so
+        # e.g. a schedule whose only non-uniformity is a phase-1 flip
+        # packs its phase-2 lanes with plain-gate traffic (the views
+        # preserve the carry's leaf set, so the pooled program's hand-off
+        # pytree matches structurally too).
+        def view_key(view_fn):
+            if reuse_sched is None:
+                return None
+            view = view_fn(reuse_sched)
+            return None if view.uniform_gate is not None else view.key()
+
+        key1 = view_key(reuse_mod.phase1_view)
+        key2 = view_key(reuse_mod.phase2_view)
+        phase1_key = ("phase1", pipe.config.name, req.steps, req.scheduler,
+                      gate_step, len(req.prompts),
+                      controller_signature(controller), key1)
         phase2_key = ("phase2", pipe.config.name, req.steps, req.scheduler,
                       gate_step, len(req.prompts),
-                      controller_signature(phase2_controller(controller)))
+                      controller_signature(phase2_controller(controller)),
+                      key2)
         phase2_batch_key = phase2_key + (float(req.guidance),)
     return PreparedRequest(request=req, controller=controller,
                            gate_step=gate_step, scan_steps=scan_steps,
@@ -317,4 +375,6 @@ def prepare(req: Request, pipe) -> PreparedRequest:
                            phase1_key=phase1_key, phase2_key=phase2_key,
                            phase2_batch_key=phase2_batch_key,
                            content_key=content_key(req, gate_step,
-                                                   pipe.config.name))
+                                                   pipe.config.name,
+                                                   sched_key),
+                           schedule=reuse_sched)

@@ -792,8 +792,9 @@ def test_compile_key_sweep_catches_masked_field(tiny_pipe):
     from p2p_tpu.analysis.compile_key import check_compile_key
 
     def masked_key(prep):
-        kind, steps, sched, _gate, lanes, treedef = prep.compile_key
-        return (kind, steps, sched, lanes, treedef)
+        (kind, steps, sched, _gate, lanes, treedef,
+         reuse_tbl) = prep.compile_key
+        return (kind, steps, sched, lanes, treedef, reuse_tbl)
 
     verdicts = check_compile_key(tiny_pipe, key_fn=masked_key,
                                  fields=["gate", "steps"])
@@ -831,8 +832,9 @@ def test_phase_key_sweep_catches_masked_gate(tiny_pipe):
     from p2p_tpu.analysis.compile_key import check_phase_keys
 
     def masked_key2(prep):
-        tag, name, steps, sched, _gate, lanes, sig = prep.phase2_key
-        return (tag, name, steps, sched, lanes, sig)
+        (tag, name, steps, sched, _gate, lanes, sig,
+         reuse_tbl) = prep.phase2_key
+        return (tag, name, steps, sched, lanes, sig, reuse_tbl)
 
     verdicts = check_phase_keys(tiny_pipe, key2_fn=masked_key2,
                                 fields=["gate", "steps"])

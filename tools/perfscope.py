@@ -93,6 +93,73 @@ def render_cards(cards: dict, peaks) -> str:
     return "\n".join(lines)
 
 
+_SITE_RE = None
+
+
+def parse_site_trace(path: str) -> list:
+    """Aggregate per-attention-site device time from a Perfetto/Chrome
+    trace (ISSUE 15, the schedule search's seed input).
+
+    Every attention site is wrapped in a ``jax.named_scope`` whose name
+    (``cross_attn/down3``) lands in the HLO op metadata, so device slices
+    in a ``jax.profiler`` / ``serve --trace-out`` export carry the site
+    name inside the op name. Events are matched by that embedded name
+    (complete-duration ``X`` events and begin/end pairs both carry
+    ``dur``), durations summed per site, shares normalized over all
+    matched sites. Accepts a raw chrome-trace JSON (a ``traceEvents``
+    object or a bare event list), ``.gz``-compressed or not."""
+    import gzip
+    import re
+
+    global _SITE_RE
+    if _SITE_RE is None:
+        _SITE_RE = re.compile(r"(cross_attn|self_attn)/(?:down|mid|up)\d+")
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", data) if isinstance(data, dict) \
+        else data
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a chrome-trace (no traceEvents "
+                         "list)")
+    durs: dict = {}
+    counts: dict = {}
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        name = e.get("name")
+        dur = e.get("dur")
+        if not name or dur is None:
+            continue
+        m = _SITE_RE.search(str(name))
+        if not m:
+            continue
+        site = m.group(0)
+        durs[site] = durs.get(site, 0.0) + float(dur)
+        counts[site] = counts.get(site, 0) + 1
+    total = sum(durs.values())
+    if not total:
+        raise ValueError(
+            f"{path}: no attention-site slices found — is this a DEVICE "
+            "trace of a named_scope-instrumented program? (site names "
+            "look like 'cross_attn/down3')")
+    return [{"site": s, "dur_us": durs[s], "slices": counts[s],
+             "share": durs[s] / total}
+            for s in sorted(durs, key=lambda s: -durs[s])]
+
+
+def render_sites(entries: list) -> str:
+    lines = [f"  {'site':22s} {'dur ms':>10s} {'slices':>7s} {'share':>7s}"]
+    for e in entries:
+        lines.append(f"  {e['site']:22s} {e['dur_us'] / 1e3:>10.3f} "
+                     f"{e['slices']:>7d} {e['share'] * 100:>6.1f}%")
+    cross = sum(e["share"] for e in entries
+                if e["site"].startswith("cross_attn/"))
+    lines.append(f"  cross-attention share of attention time: "
+                 f"{cross * 100:.1f}%")
+    return "\n".join(lines)
+
+
 def render_programs(entries: list) -> str:
     lines = [f"  {'program':40s} {'flops':>12s} {'bytes':>12s} "
              f"{'bound':>9s} {'pred ms':>8s} {'disp':>5s} "
@@ -125,6 +192,12 @@ def main(argv=None) -> int:
     ap.add_argument("--programs", default=None, metavar="FILE",
                     help="render a serve --programs-out JSONL artifact "
                          "instead of compiling the canonical programs")
+    ap.add_argument("--sites", default=None, metavar="TRACE",
+                    help="render the per-attention-site step-time share "
+                         "table from a recorded Perfetto/chrome device "
+                         "trace (named_scope site names) — the reuse-"
+                         "schedule search's seed input "
+                         "(tools/schedule_search.py --sites-json)")
     ap.add_argument("--budgets", default=None, metavar="FILE",
                     help="budgets file (default: tools/cost_budgets.json)")
     ap.add_argument("--json", default=None, metavar="FILE",
@@ -145,11 +218,24 @@ def main(argv=None) -> int:
                           or args.check_budgets):
         ap.error("--programs renders a recorded artifact; it takes none "
                  "of --headline/--check-budgets/--update-budgets")
+    if args.sites and (args.programs or args.headline
+                       or args.update_budgets or args.check_budgets):
+        ap.error("--sites renders a recorded trace; it takes none of "
+                 "--programs/--headline/--check-budgets/--update-budgets")
 
     report: dict = {}
     rc = 0
 
-    if args.programs:
+    if args.sites:
+        try:
+            entries = parse_site_trace(args.sites)
+        except (OSError, ValueError) as e:
+            print(f"--sites: {e}", file=sys.stderr)
+            return 2
+        print(f"{len(entries)} attention site(s) from {args.sites}")
+        print(render_sites(entries))
+        report["sites"] = entries
+    elif args.programs:
         entries = []
         with open(args.programs) as f:
             for line in f:

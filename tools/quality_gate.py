@@ -83,6 +83,88 @@ def _phase_gate_drift():
     return float((d ** 2).mean()), float(np.abs(d).max())
 
 
+def _schedule_check():
+    """The reuse-schedule leg (ISSUE 15), default-on — re-validates the
+    COMMITTED search artifact (tools/schedules/default_v1.json) end to
+    end:
+
+    1. **golden drift** — the artifact resolved on the rehearsal workload
+       (the exact trajectory the phase-gate golden pins) must stay inside
+       the ≤1e-2 latent-MSE budget, with the same foreign-platform
+       fallback as the phase_gate leg;
+    2. **uniform parity** — a request whose schedule is the UNIFORM table
+       must serve byte-identically to the equivalent ``gate=g`` request
+       (and derive the identical compile key): the generalization's
+       bitwise contract at the serving surface;
+    3. **contracts** — the no-f64 and hot-scan-callback jaxpr contracts
+       over the scheduled canonical programs (monolith + both pools).
+
+    Returns (mse, speedup_recorded, uniform_bitwise, keys_pooled,
+    contract_failures)."""
+    import json
+
+    import jax
+
+    from p2p_tpu.engine.sampler import text2image
+    from p2p_tpu.models import TINY
+    from p2p_tpu.parallel import sweep
+    from p2p_tpu.serve import Request, serve_forever
+    from p2p_tpu.serve.request import prepare
+    from tests.test_golden import _pipe
+    from tests.test_phase_cache import PLATFORM_TOL, STEPS, _sweep_inputs
+
+    art_path = os.path.join(_REPO, "tools", "schedules", "default_v1.json")
+    with open(art_path) as f:
+        spec = json.load(f)
+
+    pipe = _pipe(TINY)
+    ctx, lats, ctrls = _sweep_inputs(pipe)
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        _, lat_base = sweep(pipe, ctx, lats, ctrls, num_steps=STEPS)
+        _, lat_sched = sweep(pipe, ctx, lats, ctrls, num_steps=STEPS,
+                             schedule=spec)
+    lat_base = np.asarray(lat_base, np.float64)
+    golden = np.load(os.path.join(_REPO, "tests", "golden",
+                                  "phase_gate.npz"))["latents_base"]
+    ref = golden.astype(np.float64)
+    if ((lat_base - ref) ** 2).mean() > PLATFORM_TOL:
+        ref = lat_base
+    mse = float(((np.asarray(lat_sched, np.float64) - ref) ** 2).mean())
+
+    # Uniform-schedule serve leg: bitwise + key-pooled with plain gate=g.
+    steps, seed = 3, 42
+    prompts = ["a squirrel eating a burger", "a squirrel eating a lasagna"]
+    gate_req = Request(request_id="uni-gate", prompt=prompts[0],
+                      target=prompts[1], mode="replace", steps=steps,
+                      seed=seed, gate=0.5)
+    uni_req = Request(request_id="uni-sched", prompt=prompts[0],
+                      target=prompts[1], mode="replace", steps=steps,
+                      seed=seed, schedule={"cfg_gate": 0.5})
+    keys_pooled = (prepare(gate_req, pipe).compile_key
+                   == prepare(uni_req, pipe).compile_key)
+    imgs = {}
+    for req in (gate_req, uni_req):
+        recs = [r for r in serve_forever(pipe, [req], max_batch=4,
+                                         max_wait_ms=1.0)
+                if r["status"] == "ok"]
+        assert len(recs) == 1, f"{req.request_id}: {len(recs)} ok records"
+        imgs[req.request_id] = recs[0]["images"]
+    uniform_bitwise = np.array_equal(imgs["uni-gate"], imgs["uni-sched"])
+
+    # Contracts over the scheduled canonical programs.
+    from p2p_tpu.analysis import contracts
+
+    progs = contracts.scheduled_programs(spec=spec)
+    results = (contracts.check_no_f64(progs)
+               + contracts.check_hot_scan_callbacks(progs))
+    fails = [r for r in results if not r.ok]
+    speedup = (spec.get("provenance") or {}).get("measured_speedup")
+    return mse, speedup, uniform_bitwise, keys_pooled, fails, len(results)
+
+
 def _serve_parity():
     """max|Δ| between golden edits served through the full request path
     (queue → batcher → program cache → sweep) and the same specs run
@@ -542,6 +624,12 @@ def main(argv=None) -> int:
                          "latents (ISSUE 1 drift contract)")
     ap.add_argument("--skip-gate", action="store_true",
                     help="skip the phase-gate drift check")
+    ap.add_argument("--skip-schedule", action="store_true",
+                    help="skip the reuse-schedule check (ISSUE 15; ~40s: "
+                         "committed-artifact drift vs the golden budget, "
+                         "uniform-schedule serve parity bitwise vs gate, "
+                         "jaxcheck contracts on scheduled canonical "
+                         "programs)")
     ap.add_argument("--skip-serve", action="store_true",
                     help="skip the serve-path parity check")
     ap.add_argument("--serve-max-abs", type=int, default=0,
@@ -622,13 +710,14 @@ def main(argv=None) -> int:
                                        "static_analysis", "flight_parity",
                                        "bench_trend", "lifecycle", "soak",
                                        "mesh_parity", "slo", "cache_parity",
-                                       "cost_regression"}
+                                       "cost_regression", "schedule"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
                      f"valid: {', '.join(cases)}, phase_gate, serve_parity, "
                      f"obs_overhead, fault_drill, static_analysis, "
                      f"flight_parity, bench_trend, lifecycle, soak, "
-                     f"mesh_parity, slo, cache_parity, cost_regression")
+                     f"mesh_parity, slo, cache_parity, cost_regression, "
+                     f"schedule")
 
     drifted = []
     for name, fn in cases.items():
@@ -660,6 +749,21 @@ def main(argv=None) -> int:
               f"{'ok' if ok else 'DRIFT'}")
         if not ok:
             drifted.append("phase_gate")
+
+    if not args.skip_schedule and (only is None or "schedule" in only):
+        mse, speedup, bitwise, pooled, fails, n_contracts = \
+            _schedule_check()
+        ok = (mse <= args.gate_mse and bitwise and pooled and not fails)
+        print(f"{'schedule':16s} artifact mse={mse:.4g} "
+              f"(recorded speedup {speedup}x), uniform-schedule serve "
+              f"{'bitwise' if bitwise else 'DIFF'}, keys "
+              f"{'pooled' if pooled else 'SPLIT'}, "
+              f"{n_contracts - len(fails)}/{n_contracts} scheduled "
+              f"contracts {'ok' if ok else 'DRIFT'}")
+        for r in fails:
+            print("  " + r.format())
+        if not ok:
+            drifted.append("schedule")
 
     if not args.skip_serve and (only is None or "serve_parity" in only):
         mx = _serve_parity()
