@@ -28,6 +28,9 @@ the same JSON line:
       single-branch U-Net + cached cross-attention past the gate; carries
       gate_step, phase{1,2}_ms_per_step and phase2_unet_batch so the
       trajectory separates algorithmic wins from kernel wins)
+  gate.kernel                            (fused in-kernel-edit attention A/B:
+      fused vs materialized vs library-flash-floor ms/step, per-variant MFU
+      and the fused/materialized speedup — benchwatch's gate.kernel.speedup)
   dpm20_imgs_per_s / dpm20_batched_{8,4}groups_imgs_per_s  (DPM-Solver++(2M)
       20 steps ≈ 50-step-DDIM quality, PERF.md)
   reweight_eqsweep_4groups_imgs_per_s    (config 3: equalizer sweep)
@@ -146,8 +149,9 @@ _TIMEOUT = object()  # sentinel: the inner subprocess hit its timeout
 # secondaries in their run order. "gate" is the phase-gated variant of the
 # headline batched-4-groups config (cross-attention caching + CFG truncation
 # past the gate step — an *algorithmic* win, reported with per-phase ms/step
-# so the trajectory can tell it apart from kernel wins).
-_BLOCK_KEYS = ("gsweep", "gate", "dpm", "dpm_batched", "reweight",
+# so the trajectory can tell it apart from kernel wins). "kernel" is the
+# fused in-kernel-edit attention A/B (ISSUE 16, the gate.kernel sub-record).
+_BLOCK_KEYS = ("gsweep", "gate", "kernel", "dpm", "dpm_batched", "reweight",
                "refine_blend", "ldm256", "serve", "obs", "cost",
                "resilience", "nullinv")
 
@@ -621,7 +625,7 @@ def _measure(preset):
 
         def run_batched(g, ctrls, seed, steps=num_steps, scheduler="ddim",
                         bpipe=None, bprompts=None, gate=None,
-                        schedule=None):
+                        schedule=None, kernels=None):
             # Prompt encoding stays inside the timed region, matching
             # what text2image times for the single-group variant. Guidance
             # always comes from the pipe's config (sweep's 7.5 default only
@@ -636,7 +640,7 @@ def _measure(preset):
                                 bpipe.latent_shape, dtype=dtype)
             imgs, _ = sweep(bpipe, ctx, lats, ctrls, num_steps=steps,
                             scheduler=scheduler, mesh=None, gate=gate,
-                            schedule=schedule,
+                            schedule=schedule, kernels=kernels,
                             guidance_scale=bpipe.config.guidance_scale)
             return np.asarray(imgs)
 
@@ -793,6 +797,84 @@ def _measure(preset):
                 sub["speedup"] = round(sched_rate / full_rate, 4)
                 sub["uniform_gate_speedup"] = round(rate / full_rate, 4)
             extras["gate"] = {"schedule": sub}
+
+        # ISSUE 16: the fused in-kernel-edit attention A/B on the headline
+        # operating point — fused (`kernels=KernelConfig`) vs the
+        # materialized reference (the batched_4groups headline itself: same
+        # controller, kernels=None) vs the library-flash floor (no
+        # controller: what the step costs with zero edit overhead — the
+        # ceiling the fused path closes toward). Recorded as the nested
+        # `gate.kernel` sub-record with per-variant ms/step and MFU (each
+        # variant's own XLA cost-card flops over its measured wall time);
+        # benchwatch reads `gate.kernel.speedup` (fused over materialized,
+        # higher is better). On CPU the kernels run through the pallas
+        # INTERPRETER — a correctness/schema rehearsal whose ms/step is
+        # recorded honestly but means nothing for speed (the interpreter
+        # is a Python loop); `interpret: true` marks those rounds so the
+        # trajectory never mistakes a rehearsal number for a chip number.
+        def kernel_variant():
+            from p2p_tpu.kernels import (VARIANT_FUSED, KernelConfig,
+                                         site_variant)
+            from p2p_tpu.models.config import unet_layout as _ulayout
+            from p2p_tpu.obs import costmodel
+
+            g = 4
+            interp = platform != "tpu"
+            kc = KernelConfig(interpret=True) if interp else KernelConfig()
+            ctrls = broadcast_groups(g, controller)
+            imgs_per_run = g * len(prompts)
+            full_rate = extras["batched_4groups_imgs_per_s"]
+
+            # Static census at the operating point: how many sites the
+            # config actually lowers fused (store-slot sites under this
+            # store-carrying controller stay materialized by design).
+            layout = _ulayout(cfg.unet)
+            fused_sites = sum(
+                1 for m in layout.metas
+                if site_variant(kc, controller, m, "off") == VARIANT_FUSED)
+
+            fused_rate = timed(lambda s, c=ctrls: run_batched(
+                g, c, s, kernels=kc)) * imgs_per_run
+            flash_rate = timed(lambda s: run_batched(
+                g, None, s)) * imgs_per_run
+
+            def ms_per_step(rate):
+                return imgs_per_run / rate / num_steps * 1000.0
+
+            sub = {
+                "fused_imgs_per_s": round(fused_rate, 4),
+                "fused_ms_per_step": round(ms_per_step(fused_rate), 2),
+                "materialized_ms_per_step": round(ms_per_step(full_rate), 2),
+                "flash_ms_per_step": round(ms_per_step(flash_rate), 2),
+                "speedup": round(fused_rate / full_rate, 4),
+                "fused_sites": fused_sites,
+                "interpret": interp,
+            }
+            # Per-variant MFU off each variant's own cost card: the fused
+            # program's flops/bytes genuinely differ (no materialized
+            # probs), so one shared card would misattribute.
+            peaks = costmodel.detect_peaks()
+            cond = encode_prompts(pipe, prompts, dtype=dtype)
+            uncond = encode_prompts(pipe, [""] * len(prompts), dtype=dtype)
+            ctx = jnp.concatenate([uncond, cond], axis=0)
+            ctx = jnp.broadcast_to(ctx[None], (g,) + ctx.shape)
+            lats = seed_latents(jax.random.PRNGKey(0), g, len(prompts),
+                                pipe.latent_shape, dtype=dtype)
+            for name, c, kk, rate in (
+                    ("fused", ctrls, kc, fused_rate),
+                    ("materialized", ctrls, None, full_rate),
+                    ("flash", None, None, flash_rate)):
+                lowered = sweep(pipe, ctx, lats, c, num_steps=num_steps,
+                                scheduler="ddim", mesh=None, kernels=kk,
+                                guidance_scale=pipe.config.guidance_scale,
+                                lower_only=True)
+                card = costmodel.card_from_compiled(
+                    lowered.compile(), program=f"kernel/{name}")
+                mfu = costmodel.mfu_pct(card.flops,
+                                        imgs_per_run / rate * 1000.0, peaks)
+                sub[f"{name}_mfu_pct"] = (None if mfu is None
+                                          else round(mfu, 2))
+            extras.setdefault("gate", {})["kernel"] = sub
 
         # Quality-matched secondary: DPM-Solver++(2M) at 20 steps reaches
         # ~50-step-DDIM quality (PERF.md) — the practical operating point.
@@ -1283,6 +1365,13 @@ def _measure(preset):
 
         secondary("gate", "phase-gate secondary", gated_variant,
                   needs_sweep=True)
+        # min_left=420: three extra sweep-scale programs (fused, flash
+        # floor, plus the lower_only cost cards) compile here.
+        secondary("kernel", "fused-kernel secondary", kernel_variant,
+                  needs_sweep=True, min_left=420,
+                  prereq="batched_4groups_imgs_per_s" in extras,
+                  prereq_msg="no batched_4groups baseline to compare "
+                             "against")
         secondary("dpm", "dpm secondary", dpm_single)
         secondary("dpm_batched", "dpm batched secondary", dpm_batched,
                   needs_sweep=True, prereq="ctrl" in dpm_ctrl,

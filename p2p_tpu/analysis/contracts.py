@@ -29,6 +29,13 @@ contracts that hand-written review keeps re-checking:
   request-scoped flight tracer (``obs.flight``: open context, attached
   spans) yields byte-identical jaxpr fingerprints: flipping flight
   tracing on/off can never change a compiled program.
+- ``no-materialized-probs`` — a canonical program dispatched through the
+  fused-edit kernel config (:func:`kernel_programs`) carries no
+  CFG-doubled ``(2B, heads, P, K)`` attention-probability softmax
+  anywhere: the prompt-to-prompt edit runs inside the attention tile, so
+  the probability tensor never exists as a program-level value. Each
+  fused program is paired with its ``kernels=None`` twin, which must trip
+  the detector (non-vacuity witness).
 
 Programs traced (:func:`canonical_programs`): text2image ungated + gated
 (phase 1/2), serve batch programs across every lane bucket (1/2/4/8, the
@@ -150,7 +157,7 @@ def _scan_inputs(pipe):
     return ctx, lats, jnp.float32(7.5)
 
 
-def _trace_denoise(pipe, ctrl, gate, metrics):
+def _trace_denoise(pipe, ctrl, gate, metrics, kernels=None):
     import jax
 
     from ..engine.sampler import _denoise_scan
@@ -165,7 +172,8 @@ def _trace_denoise(pipe, ctrl, gate, metrics):
 
     def run(up, ctx, lats, gs):
         return _denoise_scan(up, cfg, layout, schedule, "ddim", ctx, lats,
-                             ctrl, gs, gate=gate, metrics=metrics)
+                             ctrl, gs, gate=gate, metrics=metrics,
+                             kernels=kernels)
 
     return jax.make_jaxpr(run)(pipe.unet_params, ctx, lats, gs)
 
@@ -190,7 +198,8 @@ def _stage_dp(x, mesh):
     return jax.device_put(x, NamedSharding(mesh, P("dp")))
 
 
-def _trace_sweep(pipe, ctrl, bucket, gate, metrics, mesh=None, reuse=None):
+def _trace_sweep(pipe, ctrl, bucket, gate, metrics, mesh=None, reuse=None,
+                 kernels=None):
     import jax
     import jax.numpy as jnp
 
@@ -215,7 +224,8 @@ def _trace_sweep(pipe, ctrl, bucket, gate, metrics, mesh=None, reuse=None):
     def run(up, vp, ctx_g, lat_g, ctrl_g, gs):
         return _sweep_jit(up, vp, cfg, layout, schedule, "ddim", ctx_g,
                           lat_g, ctrl_g, gs, None, progress=False,
-                          gate=gate, metrics=metrics, reuse=reuse)
+                          gate=gate, metrics=metrics, reuse=reuse,
+                          kernels=kernels)
 
     return jax.make_jaxpr(run)(pipe.unet_params, pipe.vae_params, ctx_g,
                                lat_g, ctrl_g, gs)
@@ -496,6 +506,64 @@ def scheduled_programs(pipe=None, spec=None, buckets=(1,),
     return programs
 
 
+def _kernel_controller(pipe):
+    """The kernel-twin controller: a replace edit whose window covers every
+    TINY attention site (``self_max_pixels`` at the largest level) with
+    ``store=False`` — no attention-store slots, so every controller-touched
+    site is kernel-compilable and the fused twin has ZERO materialized
+    CFG-doubled probability tensors by construction. The canonical
+    ``_edit_controller`` keeps ``store=True`` (store sites stay materialized
+    by design), which would make the no-materialized-probs detector
+    trivially fail on sites the kernel deliberately does not claim."""
+    from ..controllers import factory
+
+    size = pipe.config.unet.sample_size
+    return factory.attention_replace(
+        list(PROMPTS), STEPS, cross_replace_steps=0.8,
+        self_replace_steps=0.4, tokenizer=pipe.tokenizer,
+        self_max_pixels=size * size, max_len=pipe.config.text.max_length,
+        store=False)
+
+
+def kernel_programs(pipe=None, metrics=False) -> List[Program]:
+    """Kernel-bearing canonical program twins (fused-edit Pallas dispatch)
+    plus their materialized counterparts under the SAME controller: the
+    sequential sampler ungated + gated, and the monolithic serve program at
+    one bucket. Each ``<name>-fused`` program traces with
+    ``KernelConfig(interpret=True)`` (the CPU-traceable rehearsal config —
+    the pallas_call program structure is identical to the compiled-TPU
+    one); ``<name>`` traces the exact same program with ``kernels=None``,
+    giving :func:`check_no_materialized_probs` its non-vacuity witness."""
+    from ..kernels import KernelConfig
+
+    if pipe is None:
+        pipe = tiny_pipeline()
+    b = len(PROMPTS)
+    ctrl = _kernel_controller(pipe)
+    kc = KernelConfig(interpret=True)
+    programs = []
+    for label, gate in (("ungated", None), ("gated", GATE)):
+        programs.append(Program(
+            f"kernel/{label}",
+            _trace_denoise(pipe, ctrl, gate=gate, metrics=metrics),
+            group_batch=b, gate=gate, metrics=metrics))
+        programs.append(Program(
+            f"kernel/{label}-fused",
+            _trace_denoise(pipe, ctrl, gate=gate, metrics=metrics,
+                           kernels=kc),
+            group_batch=b, gate=gate, metrics=metrics))
+    programs.append(Program(
+        "kernel/serve-bucket1",
+        _trace_sweep(pipe, ctrl, bucket=1, gate=GATE, metrics=metrics),
+        group_batch=b, gate=GATE, metrics=metrics, lead_dims=(1,)))
+    programs.append(Program(
+        "kernel/serve-bucket1-fused",
+        _trace_sweep(pipe, ctrl, bucket=1, gate=GATE, metrics=metrics,
+                     kernels=kc),
+        group_batch=b, gate=GATE, metrics=metrics, lead_dims=(1,)))
+    return programs
+
+
 # ---------------------------------------------------------------------------
 # Contracts
 # ---------------------------------------------------------------------------
@@ -651,6 +719,70 @@ def check_phase2_footprint(programs: List[Program]) -> List[ContractResult]:
                    f"phase2 body ({len(body2)} eqns) not smaller than "
                    f"phase1 ({len(body1)})"))
         out.append(ContractResult("phase2-footprint", p.name, ok, detail))
+    return out
+
+
+def _materialized_probs_eqns(p: Program) -> List[Tuple[int, ...]]:
+    """Shapes of CFG-doubled attention-probability softmaxes a program
+    materializes: ``exp`` equations over 4-D f32 operands (plus the vmap
+    group prefix for serve programs) whose CFG batch dim is exactly ``2B``.
+    In this stack the only 4-D f32 exp with a CFG-doubled leading dim is
+    the attention softmax (``models.nn.attention_probs``); the fused-edit
+    kernel's in-tile softmax runs on 2-D ``(block_q, K)`` tiles, so
+    recursing into pallas_call bodies cannot false-positive, and the
+    phase-2 single-branch path (batch ``B``) is out of scope by
+    construction — the contract is about the ``(2B, heads, P, K)`` tensor
+    the ISSUE's roofline names."""
+    lead = len(p.lead_dims)
+    hits = []
+    for eqn in jaxpr_walk.all_eqns(p.jaxpr):
+        if eqn.primitive.name != "exp":
+            continue
+        aval = eqn.invars[0].aval
+        shape = tuple(getattr(aval, "shape", ()))
+        if (len(shape) == 4 + lead and str(getattr(aval, "dtype", ""))
+                == "float32" and shape[lead] == 2 * p.group_batch):
+            hits.append(shape)
+    return hits
+
+
+def check_no_materialized_probs(
+        programs: List[Program]) -> List[ContractResult]:
+    """The kernel-bearing twin contract (ISSUE 16): a canonical program
+    dispatched through the fused-edit kernel config materializes NO
+    CFG-doubled ``(2B, heads, P, K)`` attention-probability tensor — the
+    edit runs inside the attention tile, so the probs never exist as a
+    program-level value (and therefore never reach HBM on chip). Each
+    ``<name>-fused`` program is paired with its ``<name>`` materialized
+    twin (same controller, ``kernels=None``), which must trip the detector
+    — a vacuous detector (e.g. the probs shape drifting past the pattern)
+    fails rather than silently passing."""
+    out = []
+    by_name = {p.name: p for p in programs}
+    for name in sorted(by_name):
+        if not name.endswith("-fused"):
+            continue
+        p = by_name[name]
+        twin = by_name.get(name[:-len("-fused")])
+        if twin is None:
+            out.append(ContractResult(
+                "no-materialized-probs", name, False,
+                "fused program has no materialized twin in the sweep"))
+            continue
+        witness = _materialized_probs_eqns(twin)
+        if not witness:
+            out.append(ContractResult(
+                "no-materialized-probs", name, False,
+                f"detector vacuous: materialized twin {twin.name} shows no "
+                "CFG-doubled softmax"))
+            continue
+        hits = _materialized_probs_eqns(p)
+        ok = not hits
+        detail = (f"0 materialized 2B-probs (twin shows "
+                  f"{len(witness)})" if ok else
+                  f"fused program still materializes CFG-doubled probs: "
+                  f"{sorted(set(hits))[:4]}")
+        out.append(ContractResult("no-materialized-probs", name, ok, detail))
     return out
 
 
@@ -830,6 +962,16 @@ def run_contracts(pipe=None, buckets=(1, 2, 4, 8)) -> List[ContractResult]:
     results += check_phase2_footprint(plain)
     results += check_pool_footprint(plain)
     results += check_donation(pipe)
+    # Kernel-bearing twins (ISSUE 16): the fused-edit dispatch programs are
+    # canonical too — they carry every structural contract the materialized
+    # programs do, plus the no-materialized-probs proof against their
+    # kernels=None twins.
+    kpairs = kernel_programs(pipe)
+    fused = [p for p in kpairs if p.name.endswith("-fused")]
+    results += check_no_f64(kpairs)
+    results += check_hot_scan_callbacks(fused)
+    results += check_phase2_footprint(fused)
+    results += check_no_materialized_probs(kpairs)
     # Flight tracing joins the disabled-invisible sweep at one bucket
     # (the check retraces the canonical set twice; the program identity
     # property is bucket-independent).

@@ -1,0 +1,155 @@
+"""Kernel-compilable edit specs: the controller treedef, lowered for Pallas.
+
+The edit algebra in :mod:`controllers.edit` is expressed over whole
+``(E, heads, P, K)`` probability tensors. A fused attention kernel sees one
+``(block_q, K)`` tile of one batch row at a time, so the per-site edit must
+be restated as *row-local* operations along the key axis. This module does
+that lowering once per (controller, site), entirely at trace time:
+
+- **Static spec** (:class:`EditSpec`, extracted by :func:`kernel_edit_spec`):
+  edit kind, equalizer presence, key geometry — everything that decides the
+  kernel *program*. ``None`` means the site is not kernel-compilable and the
+  caller must keep the materialized reference path.
+
+- **Traced operands** (:func:`edit_operands`): the per-edit-row arrays the
+  kernel consumes, all padded to the lane-aligned key length ``pad_len``:
+
+  ===========  ===========  ====================================================
+  operand      shape        semantics
+  ===========  ===========  ====================================================
+  ``transform`` (E, Kp, Kp)  key-axis projection ``M``: Replace's word-swap
+                             matrix, or Refine's gather stated as a one-hot
+                             matmul (``gathered = base @ onehot(mapper)``) —
+                             the "in-tile gather over the key axis"
+  ``refine_mix`` (E, Kp)     Refine's per-token source/edit blend ``ra``
+  ``equalizer``  (E, Kp)     Reweight's per-key-token scale (1s when absent)
+  ``blend``      (E, Kp)     the per-step schedule blend α: cross sites index
+                             ``cross_alpha[step]``; self sites broadcast the
+                             0/1 injection-window predicate (full-row
+                             injection ≡ α-blend with α ∈ {0, 1})
+  ===========  ===========  ====================================================
+
+  With those, every edit family is ONE kernel formula over a probability
+  tile (``probs`` = the edit row's own softmax, ``base`` = the source
+  prompt's row):
+
+      t      = base @ M                      (skipped when kind == 'none')
+      new    = t·ra + probs·(1 − ra)         (ra ≡ 1 except Refine)
+      new    = new · equalizer
+      edited = new·α + (1 − α)·probs
+
+  which reproduces ``edit_cross_attention`` / ``edit_self_attention``
+  exactly (Reweight stays a *post*-softmax scale, unnormalized — the
+  reference semantics; the padded key columns carry masked logits, zero
+  transform rows and α = 0, so they contribute nothing).
+
+Compilability is deliberately conservative: sites whose post-edit maps feed
+the attention *store* (LocalBlend / visualization) need the materialized
+tensor by definition and stay on the reference path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import AttnMeta, Controller, controller_touches
+from .edit import EditParams
+
+#: TPU lane width — the kernel's key axis is padded to a multiple of this.
+LANE = 128
+
+
+def padded_key_len(key_len: int) -> int:
+    return max(LANE, ((key_len + LANE - 1) // LANE) * LANE)
+
+
+@dataclasses.dataclass(frozen=True)
+class EditSpec:
+    """Static (hashable) description of one site's in-kernel edit program."""
+
+    kind: str            # 'replace' | 'refine' | 'none'
+    is_cross: bool
+    has_equalizer: bool
+    key_len: int         # unpadded K (context_len for cross, pixels for self)
+    pad_len: int         # K padded to the TPU lane multiple
+
+    @property
+    def has_transform(self) -> bool:
+        return self.kind in ("replace", "refine")
+
+
+def kernel_edit_spec(controller: Optional[Controller],
+                     meta: AttnMeta) -> Optional[EditSpec]:
+    """The site's :class:`EditSpec`, or ``None`` if the fused kernel cannot
+    express what the controller does there.
+
+    Kernel-compilable ⇔ the controller *edits* the site (cross always; self
+    within ``self_max_pixels``) and does NOT store its maps: the store
+    accumulates whole post-edit probability tensors
+    (``apply_attention_control``), which is exactly the materialization the
+    kernel exists to avoid. All inputs are static, so dispatch on the result
+    costs nothing in the compiled program."""
+    if controller is None or controller.is_identity or controller.edit is None:
+        return None
+    if not controller_touches(controller, meta):
+        return None
+    if meta.store_slot is not None and controller.needs_store:
+        return None
+    if not meta.is_cross and meta.pixels > controller.edit.self_max_pixels:
+        return None
+    edit = controller.edit
+    kind = edit.kind if meta.is_cross else "none"
+    return EditSpec(
+        kind=kind,
+        is_cross=meta.is_cross,
+        has_equalizer=meta.is_cross and edit.equalizer is not None,
+        key_len=meta.key_len,
+        pad_len=padded_key_len(meta.key_len),
+    )
+
+
+def edit_operands(params: EditParams, spec: EditSpec, step: jax.Array) -> dict:
+    """Build the kernel's per-edit-row operand arrays (see module docstring)
+    for one site at one (traced) step. All f32, key axis padded to
+    ``spec.pad_len``; entries not used by ``spec.kind`` are omitted."""
+    num_edits = params.cross_alpha.shape[1]
+    kp = spec.pad_len
+    ops: dict = {}
+
+    if spec.is_cross:
+        k = spec.key_len
+        alpha = jax.lax.dynamic_index_in_dim(params.cross_alpha, step, axis=0,
+                                             keepdims=False)
+        alpha = alpha.reshape(num_edits, k).astype(jnp.float32)
+        ops["blend"] = jnp.pad(alpha, ((0, 0), (0, kp - k)))
+        if spec.kind == "replace":
+            m = params.mapper.astype(jnp.float32)          # (E, K, K)
+            ops["transform"] = jnp.pad(m, ((0, 0), (0, kp - k), (0, kp - k)))
+        elif spec.kind == "refine":
+            # Refine's gather, restated as a matmul the MXU can run in-tile:
+            # gathered[..., n] = base[..., mapper[e, n]]  ⇔  base @ M with
+            # M[w, n] = [w == mapper[e, n]]. The reference's -1 entries
+            # (tokens new in the edit prompt) wrap to the last column and
+            # carry refine_alpha 0, so the wrapped one-hot column is exact.
+            idx = params.mapper % k                        # (E, K), wrapped
+            onehot = (jnp.arange(kp, dtype=jnp.int32)[None, :, None]
+                      == idx[:, None, :]).astype(jnp.float32)  # (E, Kp, K)
+            ops["transform"] = jnp.pad(onehot, ((0, 0), (0, 0), (0, kp - k)))
+            ra = params.refine_alphas.reshape(num_edits, k).astype(jnp.float32)
+            ops["refine_mix"] = jnp.pad(ra, ((0, 0), (0, kp - k)))
+        if spec.has_equalizer:
+            eq = params.equalizer.astype(jnp.float32)      # (E, K)
+            ops["equalizer"] = jnp.pad(eq, ((0, 0), (0, kp - k)),
+                                       constant_values=1.0)
+    else:
+        # Self-attention injection: inside the step window the edit rows'
+        # maps are the base row's maps — an α-blend with α = [in window].
+        in_window = jnp.logical_and(step >= params.self_start,
+                                    step < params.self_end)
+        ops["blend"] = jnp.broadcast_to(
+            in_window.astype(jnp.float32), (num_edits, kp))
+    return ops

@@ -451,6 +451,31 @@ def segments(layout, sched: ReuseSchedule, phase: int) -> List[Segment]:
     return segs
 
 
+def lower_kernel_plan(layout, sched: ReuseSchedule, controller, kernels,
+                      phase: int) -> List[Tuple[Segment, Tuple[str, ...]]]:
+    """Static kernel lowering of one phase: for every constant-plan segment
+    (:func:`segments`), the attention variant each site compiles to under
+    ``kernels`` (a ``kernels.KernelConfig`` or None) — the
+    ``kernels.dispatch.site_variant`` vocabulary (``use`` / ``flash`` /
+    ``fused-edit`` / ``materialized``). Pure trace-time introspection over
+    the same static inputs the executors consume: what
+    ``_scheduled_phase1/2`` + ``apply_unet`` will actually lower, without
+    building the program. ``use`` segments lower to the cache side-input
+    (no attention math); ``store``/``store_all`` segments capture the site
+    output *after* whichever attention variant runs — the fused
+    side-output — so a controller-edited site keeps its fused-edit
+    lowering while storing."""
+    from ..kernels.dispatch import site_variant
+
+    out = []
+    for seg in segments(layout, sched, phase):
+        variants = tuple(
+            site_variant(kernels, controller, m, mode)
+            for m, mode in zip(layout.metas, seg.plan))
+        out.append((seg, variants))
+    return out
+
+
 def init_schedule_cache(layout, sched: ReuseSchedule, batch_cond: int,
                         phase: int, dtype) -> Tuple:
     """Zero cache leaves for every ever-cached site, in call order.

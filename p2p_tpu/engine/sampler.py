@@ -335,6 +335,7 @@ def _make_scheduled_body(
     site_plan: Tuple[str, ...],
     resid_const: Optional[jax.Array] = None,
     state_const: Tuple = (),
+    kernels=None,
 ):
     """One reuse-schedule SEGMENT's scan body (engine.reuse): the per-site
     action vector ``site_plan`` is constant over the segment, so each
@@ -359,7 +360,8 @@ def _make_scheduled_body(
             eps, state, cache = apply_unet(
                 unet_params, cfg.unet, latent_in, t, context,
                 layout=layout, controller=controller, state=state,
-                step=step, sp=sp, attn_cache=cache, site_plan=site_plan)
+                step=step, sp=sp, attn_cache=cache, site_plan=site_plan,
+                kernels=kernels)
             eps_uncond, eps_text = eps[:b], eps[b:]
             resid = eps_text - eps_uncond
             eps = eps_uncond + guidance_scale * resid
@@ -373,7 +375,7 @@ def _make_scheduled_body(
         eps_text, _, cache = apply_unet(
             unet_params, cfg.unet, latents, t, context,
             layout=layout, controller=None, state=(), step=step, sp=sp,
-            attn_cache=cache, site_plan=site_plan)
+            attn_cache=cache, site_plan=site_plan, kernels=kernels)
         eps = eps_text + (guidance_scale - 1.0) * resid_const
         eps = sched_mod.to_epsilon(schedule, eps, t, latents)
         ms, latents = ms_step(ms, eps, t, latents)
@@ -399,6 +401,7 @@ def _scheduled_phase1(
     progress: bool = False,
     metrics: bool = False,
     sp: Optional["SpConfig"] = None,
+    kernels=None,                  # kernels.KernelConfig (static)
 ) -> PhaseCarry:
     """The generalized phase-1 executor: steps ``[0, cfg_gate)`` under full
     CFG, cut into constant-plan segments (engine.reuse.segments). Sites
@@ -427,7 +430,8 @@ def _scheduled_phase1(
         body = _make_scheduled_body(unet_params, cfg, layout, schedule,
                                     scheduler_kind, context, b, controller,
                                     guidance_scale, emit, progress, sp,
-                                    cfg_active=True, site_plan=seg.plan)
+                                    cfg_active=True, site_plan=seg.plan,
+                                    kernels=kernels)
         carry, _ = jax.lax.scan(
             body, carry,
             (steps[seg.start:seg.stop],
@@ -453,6 +457,7 @@ def _scheduled_phase2(
     progress: bool = False,
     metrics: bool = False,
     sp: Optional["SpConfig"] = None,
+    kernels=None,                  # kernels.KernelConfig (static)
 ) -> jax.Array:
     """The generalized phase-2 executor: steps ``[cfg_gate, S)`` off a
     :class:`PhaseCarry`, segmented so sites may keep computing
@@ -475,7 +480,8 @@ def _scheduled_phase2(
                                     guidance_scale, emit, progress, sp,
                                     cfg_active=False, site_plan=seg.plan,
                                     resid_const=carry.resid,
-                                    state_const=carry.state)
+                                    state_const=carry.state,
+                                    kernels=kernels)
         c2, _ = jax.lax.scan(
             body, c2,
             (steps[seg.start:seg.stop],
@@ -498,6 +504,7 @@ def _make_phase1_body(
     progress: bool,
     sp: Optional["SpConfig"],
     capture: bool,
+    kernels=None,
 ):
     """The CFG scan body — phase 1 of a gated scan (``capture=True``:
     carries the AttnCache + CFG residual) or the whole ungated scan
@@ -527,12 +534,12 @@ def _make_phase1_body(
             eps, state, cache = apply_unet(
                 unet_params, cfg.unet, latent_in, t, ctx,
                 layout=layout, controller=controller, state=state, step=step,
-                sp=sp, attn_cache=cache, cache_mode="store")
+                sp=sp, attn_cache=cache, cache_mode="store", kernels=kernels)
         else:
             eps, state = apply_unet(
                 unet_params, cfg.unet, latent_in, t, ctx,
                 layout=layout, controller=controller, state=state, step=step,
-                sp=sp)
+                sp=sp, kernels=kernels)
         eps_uncond, eps_text = eps[:b], eps[b:]
         if capture:
             resid = eps_text - eps_uncond
@@ -568,6 +575,7 @@ def _phase1_scan(
     metrics: bool = False,
     sp: Optional["SpConfig"] = None,
     reuse=None,                    # engine.reuse.ReuseSchedule (static)
+    kernels=None,                  # kernels.KernelConfig (static)
 ) -> PhaseCarry:
     """Scan steps ``[0, gate)`` with full CFG + controller hooks, capturing
     every cross-attention output and the CFG residual. Returns the
@@ -584,7 +592,8 @@ def _phase1_scan(
         return _scheduled_phase1(unet_params, cfg, layout, schedule,
                                  scheduler_kind, context, latents,
                                  controller, guidance_scale, reuse=reuse,
-                                 progress=progress, metrics=metrics, sp=sp)
+                                 progress=progress, metrics=metrics, sp=sp,
+                                 kernels=kernels)
     emit = progress or metrics
     b = latents.shape[0]
     state = (init_store_state(layout, b, dtype=jnp.float32)
@@ -594,7 +603,7 @@ def _phase1_scan(
     body = _make_phase1_body(unet_params, cfg, layout, schedule,
                              scheduler_kind, context, b, controller,
                              guidance_scale, None, emit, progress, sp,
-                             capture=True)
+                             capture=True, kernels=kernels)
     num_scan = schedule.timesteps.shape[0]
     assert 1 <= gate <= num_scan, (gate, num_scan)
     steps = jnp.arange(num_scan, dtype=jnp.int32)
@@ -623,6 +632,7 @@ def _phase2_scan(
     metrics: bool = False,
     sp: Optional["SpConfig"] = None,
     reuse=None,                    # engine.reuse.ReuseSchedule (static)
+    kernels=None,                  # kernels.KernelConfig (static)
 ) -> jax.Array:
     """Scan steps ``[gate, S)`` off a :class:`PhaseCarry`: single-branch
     U-Net (no uncond batch half), guidance as a fixed extrapolation off the
@@ -637,7 +647,8 @@ def _phase2_scan(
         return _scheduled_phase2(unet_params, cfg, layout, schedule,
                                  scheduler_kind, context_cond, carry,
                                  controller, guidance_scale, reuse=reuse,
-                                 progress=progress, metrics=metrics, sp=sp)
+                                 progress=progress, metrics=metrics, sp=sp,
+                                 kernels=kernels)
     emit = progress or metrics
     ms_step = _make_ms_step(schedule, scheduler_kind)
     cache, resid, state = carry.cache, carry.resid, carry.state
@@ -688,6 +699,7 @@ def _denoise_scan(
     gate: Optional[int] = None,    # static: first phase-2 scan step; None/S = off
     metrics: bool = False,         # static: trace the telemetry callback in
     reuse=None,                    # engine.reuse.ReuseSchedule (static)
+    kernels=None,                  # kernels.KernelConfig (static)
 ) -> Tuple[jax.Array, StoreState]:
     """Scan over timesteps. Returns (final latents, final store state).
 
@@ -736,7 +748,7 @@ def _denoise_scan(
             carry = _scheduled_phase1(
                 unet_params, cfg, layout, schedule, scheduler_kind,
                 context, latents, controller, guidance_scale, reuse=reuse,
-                progress=progress, metrics=metrics, sp=sp)
+                progress=progress, metrics=metrics, sp=sp, kernels=kernels)
             if reuse.cfg_gate >= num_scan:
                 # CFG never drops: the whole scan ran in the (segmented)
                 # CFG phase; cached sites still saved their compute.
@@ -744,7 +756,8 @@ def _denoise_scan(
             latents = _scheduled_phase2(
                 unet_params, cfg, layout, schedule, scheduler_kind,
                 context[b:], carry, controller, guidance_scale,
-                reuse=reuse, progress=progress, metrics=metrics, sp=sp)
+                reuse=reuse, progress=progress, metrics=metrics, sp=sp,
+                kernels=kernels)
             return latents, carry.state
     if gate is None:
         gate = num_scan
@@ -769,7 +782,7 @@ def _denoise_scan(
         body = _make_phase1_body(unet_params, cfg, layout, schedule,
                                  scheduler_kind, context, b, controller,
                                  guidance_scale, uncond_per_step, emit,
-                                 progress, sp, capture=False)
+                                 progress, sp, capture=False, kernels=kernels)
         steps = jnp.arange(num_scan, dtype=jnp.int32)
         (latents, state, _), _ = jax.lax.scan(
             body, (latents, state, ms_state),
@@ -784,20 +797,20 @@ def _denoise_scan(
     carry = _phase1_scan(unet_params, cfg, layout, schedule, scheduler_kind,
                          context, latents, controller, guidance_scale,
                          gate=gate, progress=progress, metrics=metrics,
-                         sp=sp)
+                         sp=sp, kernels=kernels)
     # Slice the conditional context half once, outside the phase-2 body: a
     # slice inside the scan would pull the full [uncond; cond] tensor into
     # the body as a constant — the uncond half must not even be an input.
     latents = _phase2_scan(unet_params, cfg, layout, schedule,
                            scheduler_kind, context[b:], carry, controller,
                            guidance_scale, gate=gate, progress=progress,
-                           metrics=metrics, sp=sp)
+                           metrics=metrics, sp=sp, kernels=kernels)
     return latents, carry.state
 
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
                                    "return_store", "progress", "sp", "gate",
-                                   "metrics", "reuse"))
+                                   "metrics", "reuse", "kernels"))
 def _text2image_jit(
     unet_params: Any,
     vae_params: Any,
@@ -817,12 +830,13 @@ def _text2image_jit(
     gate: Optional[int] = None,
     metrics: bool = False,
     reuse=None,
+    kernels=None,
 ):
     context = jnp.concatenate([context_uncond, context_cond], axis=0)
     latents, state = _denoise_scan(
         unet_params, cfg, layout, schedule, scheduler_kind, context, latents,
         controller, guidance_scale, uncond_per_step, progress=progress, sp=sp,
-        gate=gate, metrics=metrics, reuse=reuse)
+        gate=gate, metrics=metrics, reuse=reuse, kernels=kernels)
     image = vae_mod.decode(vae_params, cfg.vae, latents.astype(jnp.float32))
     image = vae_mod.to_uint8(image)
     return (image, latents, state) if return_store else (image, latents, ())
@@ -848,6 +862,7 @@ def text2image(
     gate=None,
     metrics: bool = False,
     schedule=None,
+    kernels=None,
 ):
     """Generate an edit group of images from prompts under attention control —
     the `/root/reference/ptp_utils.py:129-172` entry point.
@@ -882,6 +897,16 @@ def text2image(
     inherited self-attention feature (A-SDM) at its own step;
     ``cfg_gate`` plays the gate's role for the CFG branch. The uniform
     table normalizes onto the exact ``gate=g`` program (bitwise).
+
+    ``kernels`` (a static :class:`p2p_tpu.kernels.KernelConfig`) routes
+    covered controller-edited attention sites to the fused-edit Pallas
+    kernel — the prompt-to-prompt edit applied inside the attention tile, so
+    the ``(2B·heads, P, K)`` probability tensor never reaches HBM (PERF.md
+    "In-kernel editing"). It is a pure lowering choice threaded through the
+    jit static args: each distinct config is one compiled program, composing
+    with ``gate``/``schedule`` segment lowering (``use`` segments skip
+    attention entirely; attention-store sites keep the materialized path).
+    ``kernels=None`` compiles the exact pre-existing program.
 
     ``metrics`` enables device-side telemetry (docs/OBSERVABILITY.md):
     phase-tagged step callbacks are traced into the program and the resolved
@@ -975,5 +1000,6 @@ def text2image(
             pipe.unet_params, pipe.vae_params, cfg, layout, tsched,
             scheduler, context_cond, context_uncond, latents, controller, gs,
             uncond_embeddings, return_store, progress=progress, sp=sp,
-            gate=gate_step, metrics=metrics, reuse=reuse_sched)
+            gate=gate_step, metrics=metrics, reuse=reuse_sched,
+            kernels=kernels)
     return image, x_t, state

@@ -1,0 +1,159 @@
+"""Interpret-mode execution of Pallas TPU kernels on the CPU backend.
+
+Two jax-0.4.37 gaps stand between the CPU test suite and the kernels:
+
+1. ``pltpu.force_tpu_interpret_mode`` does not exist yet (it landed in a
+   later jax). :func:`force_tpu_interpret_mode` provides the same contract
+   by rebinding ``pl.pallas_call`` to force ``interpret=True`` inside the
+   context — the library flash kernel and every kernel in this package go
+   through that one symbol.
+
+2. The pallas *interpreter* discharges ``masked_load``/``masked_swap`` with
+   a rule that calls ``.shape`` on every index element
+   (``jax/_src/pallas/primitives.py:482``) — but indices may be plain
+   Python ints (any ``ref[i, j]`` with scalar components), so discharging
+   the library flash kernel raises ``AttributeError: 'int' object has no
+   attribute 'shape'``. That is the whole reason tests/test_flash_pallas.py
+   carried xfail pins. :func:`install_discharge_fix` re-registers both
+   rules with the upstream one-line repair (treat shapeless index elements
+   as scalars via ``getattr(s, "shape", ())``) — byte-for-byte the stock
+   rules otherwise, so compiled-TPU behavior (which never runs discharge)
+   is untouched.
+
+Both are CPU-rehearsal plumbing: on a real TPU the kernels lower through
+Mosaic and neither code path runs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax._src import dtypes
+from jax._src.pallas import primitives as _pallas_primitives
+from jax._src.state import discharge as _state_discharge
+from jax._src.state.indexing import Slice
+import jax.experimental.pallas as pl
+import jax.experimental.pallas.tpu as pltpu
+
+_FIX_INSTALLED = False
+
+
+def _is_scalar_idx(s) -> bool:
+    """A shapeless (scalar) index element: a 0-d array, or — the case the
+    stock jax-0.4.37 rule crashes on — a plain Python/numpy int."""
+    return not isinstance(s, Slice) and not getattr(s, "shape", ())
+
+
+def _fixed_load_discharge_rule(in_avals, out_avals, *args_flat, args_tree,
+                               **_):
+    del out_avals
+    ref, indexers, mask, other = args_tree.unflatten(args_flat)
+    if len(indexers) > 1:
+        raise NotImplementedError("Only one indexer supported in discharge rule.")
+    idx = indexers[0]
+    if all(isinstance(s, Slice) or _is_scalar_idx(s) for s in idx.indices):
+        for s in idx.indices:
+            if isinstance(s, Slice) and s.stride > 1:
+                raise NotImplementedError("Unimplemented stride support.")
+        indices = idx.indices
+        scalar_dims = [_is_scalar_idx(s) for s in indices]
+        slice_starts = [s.start if isinstance(s, Slice) else s for s in indices]
+        slice_sizes = tuple(s.size if isinstance(s, Slice) else 1 for s in indices)
+        ref = _pallas_primitives._pad_values_to_avoid_dynamic_slice_oob_shift(
+            ref, slice_sizes)
+        idx_dtype = dtypes.canonicalize_dtype(jnp.int64)
+        out_ones = lax.dynamic_slice(
+            ref, [jnp.astype(s, idx_dtype) for s in slice_starts],
+            slice_sizes=slice_sizes)
+        out_indexer = tuple(0 if scalar else slice(None) for scalar in scalar_dims)
+        out = out_ones[out_indexer]
+    elif all(not isinstance(s, Slice) for s in idx.indices):
+        out = ref[idx.indices]
+    else:
+        raise NotImplementedError
+    if mask is not None and other is not None:
+        out = jnp.where(mask, out, other)
+    return (None,) * len(in_avals), out
+
+
+def _fixed_swap_discharge_rule(in_avals, out_avals, *args_flat, args_tree,
+                               **_):
+    del out_avals
+    ref, indexers, val, mask = args_tree.unflatten(args_flat)
+    if len(indexers) > 1:
+        raise NotImplementedError("Only one indexer supported in discharge rule.")
+    idx = indexers[0]
+    if all(isinstance(s, Slice) or _is_scalar_idx(s) for s in idx.indices):
+        for s in idx.indices:
+            if isinstance(s, Slice) and s.stride > 1:
+                raise NotImplementedError("Unimplemented stride support.")
+        indices = idx.indices
+        scalar_dims = [i for i, s in enumerate(indices) if _is_scalar_idx(s)]
+        slice_starts = [s.start if isinstance(s, Slice) else s for s in indices]
+        slice_sizes = tuple(s.size if isinstance(s, Slice) else 1 for s in indices)
+        ref = _pallas_primitives._pad_values_to_avoid_dynamic_slice_oob_shift(
+            ref, slice_sizes)
+        out = lax.dynamic_slice(ref, slice_starts, slice_sizes=slice_sizes)
+        out = jnp.squeeze(out, scalar_dims)
+        if mask is not None:
+            out_ = out
+            out = jnp.where(mask, out, val)
+            val = jnp.where(mask, val, out_)
+        val = jnp.expand_dims(val, scalar_dims)
+        x_new = lax.dynamic_update_slice(ref, val, start_indices=slice_starts)
+        x_new = _pallas_primitives._unpad_values_to_avoid_dynamic_slice_oob_shift(
+            x_new, slice_sizes)
+    elif all(not isinstance(s, Slice) for s in idx.indices):
+        out = ref[idx.indices]
+        if mask is not None:
+            out_ = out
+            out = jnp.where(mask, out, val)
+            val = jnp.where(mask, val, out_)
+        x_new = ref.at[idx.indices].set(val)
+    else:
+        raise NotImplementedError
+    return (x_new,) + (None,) * (len(in_avals) - 1), out
+
+
+def install_discharge_fix() -> None:
+    """Re-register the repaired masked-load/swap discharge rules (idempotent,
+    process-global). Strictly widens the set of programs the interpreter can
+    discharge: every case the stock rules handled takes the identical path."""
+    global _FIX_INSTALLED
+    if _FIX_INSTALLED:
+        return
+    _state_discharge.register_discharge_rule(_pallas_primitives.load_p)(
+        _fixed_load_discharge_rule)
+    _state_discharge.register_discharge_rule(_pallas_primitives.swap_p)(
+        _fixed_swap_discharge_rule)
+    _FIX_INSTALLED = True
+
+
+@contextlib.contextmanager
+def force_tpu_interpret_mode():
+    """Run every ``pl.pallas_call`` in the context through the pallas
+    interpreter (CPU-executable) — the jax-0.4.37 stand-in for
+    ``pltpu.force_tpu_interpret_mode``, deferring to the real thing when the
+    installed jax has it. Installs the discharge fix either way (newer jax
+    ships it upstream, where installing ours is a no-op rebind of
+    equivalent rules)."""
+    install_discharge_fix()
+    native = getattr(pltpu, "force_tpu_interpret_mode", None)
+    if native is not None:
+        with native():
+            yield
+        return
+    original = pl.pallas_call
+
+    def _interpreted_pallas_call(*args, **kwargs):
+        kwargs["interpret"] = True
+        return original(*args, **kwargs)
+
+    pl.pallas_call = _interpreted_pallas_call
+    try:
+        yield
+    finally:
+        pl.pallas_call = original

@@ -274,6 +274,37 @@ def flash_block(seq_len: int, head_dim: int, itemsize: int) -> int:
     return 0
 
 
+def edit_block(pixels: int, key_len: int, head_dim: int, itemsize: int) -> int:
+    """Largest query block for the fused-edit kernel (``kernels.fused_edit``)
+    that tiles ``pixels`` and stays inside the scoped-VMEM budget; 0 → no
+    viable block (the site keeps the materialized reference path).
+
+    The edit kernel's resident footprint per grid step differs from the
+    flash kernel's (``flash_block``): the key axis is NOT blocked — a full
+    lane-padded ``Kp`` lives in VMEM so edit rows see whole probability rows
+    — and each instance holds its own + the base row's tiles. Per block:
+    3 q/out tiles (own q, base q, out) + 3 key-axis tiles (k, base k, v) in
+    the carrier dtype, 3 f32 probability tiles (own, base, edited), the
+    ``(Kp, Kp)`` f32 edit transform, and f32 matmul accumulators. Same
+    14 MiB budget (of the 16 MiB scoped VMEM) as the flash geometry —
+    see the headroom note above ``_FLASH_VMEM_BUDGET``."""
+    kp = max(128, -(-key_len // 128) * 128)
+
+    def vmem(bq: int) -> int:
+        return (3 * bq * head_dim * itemsize + 3 * kp * head_dim * itemsize
+                + 3 * bq * kp * 4 + kp * kp * 4 + 2 * bq * head_dim * 4)
+
+    for bq in (512, 256, 128):
+        if pixels % bq == 0 and vmem(bq) <= _FLASH_VMEM_BUDGET:
+            return bq
+    # Small or non-power-of-two maps (edited self sites, tiny test configs):
+    # one block over the whole query axis if it fits.
+    if pixels < 128 or all(pixels % bq for bq in (512, 256, 128)):
+        if vmem(pixels) <= _FLASH_VMEM_BUDGET:
+            return pixels
+    return 0
+
+
 def _flash_block_sizes(blk: int):
     """The one BlockSizes geometry every flash call site uses — forward and
     residuals variants must stay on the same tiling.

@@ -215,7 +215,8 @@ class _HookCtx:
                  sp: Optional["SpConfig"] = None,
                  attn_cache: Optional[AttnCache] = None,
                  cache_mode: str = "off",
-                 site_plan: Optional[Tuple[str, ...]] = None):
+                 site_plan: Optional[Tuple[str, ...]] = None,
+                 kernels=None):
         self.layout = layout
         self.controller = controller
         self.state = state
@@ -230,6 +231,10 @@ class _HookCtx:
         # leaves the cache tuple holds in the same order.
         self.site_plan = site_plan
         self.cross_cursor = 0
+        # Fused-kernel dispatch plan (kernels.KernelConfig or None): static,
+        # so each covered controller-touched site lowers to the in-kernel
+        # edit program instead of the materialized f32 path.
+        self.kernels = kernels
 
     def next_meta(self):
         meta = self.layout.metas[self.cursor]
@@ -295,6 +300,31 @@ def _site_mode(ctx: _HookCtx, meta, is_cross: bool) -> str:
     return "off"
 
 
+def _fused_edit_dispatch(ctx: _HookCtx, meta, q, k, v, scale):
+    """Route a controller-touched site to the fused-edit Pallas kernel
+    (``kernels.fused_edit``) when the static dispatch plan covers it; None →
+    the caller keeps the materialized reference path. The kernel applies the
+    controller's edit inside a tiled softmax, so the ``(2B, heads, P, K)``
+    probability tensor never reaches HBM at fused sites. Compiled-kernel
+    lowering only exists on TPU; ``interpret=True`` configs run the
+    identical program through the pallas interpreter (the CPU parity
+    surface). Attention-STORE sites are never fused (``kernel_edit_spec``
+    returns None for them — the store needs the materialized tensor)."""
+    if ctx.kernels is None:
+        return None
+    if not (ctx.kernels.interpret or nn._on_tpu()):
+        return None
+    from .. import kernels as kernels_mod
+
+    if not ctx.kernels.covers(kernels_mod.dispatch.site_name(meta)):
+        return None
+    from ..kernels.fused_edit import fused_site_attention
+
+    return fused_site_attention(q, k, v, scale, ctx.controller, meta,
+                                ctx.step, block_q=ctx.kernels.block_q,
+                                interpret=ctx.kernels.interpret)
+
+
 def _attention_site(p: Params, x: jax.Array, context: jax.Array, heads: int,
                     ctx: _HookCtx, meta, is_cross: bool) -> jax.Array:
     mode = _site_mode(ctx, meta, is_cross)
@@ -327,10 +357,12 @@ def _attention_site(p: Params, x: jax.Array, context: jax.Array, heads: int,
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
 
     if controller_touches(ctx.controller, meta):
-        probs = nn.attention_probs(q, k, scale)            # (B, heads, P, K) f32
-        ctx.state, probs = apply_attention_control(
-            ctx.controller, meta, ctx.state, probs, ctx.step)
-        out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+        out = _fused_edit_dispatch(ctx, meta, q, k, v, scale)
+        if out is None:
+            probs = nn.attention_probs(q, k, scale)        # (B, heads, P, K) f32
+            ctx.state, probs = apply_attention_control(
+                ctx.controller, meta, ctx.state, probs, ctx.step)
+            out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
     elif (ctx.sp is not None and not is_cross
           and meta.pixels >= ctx.sp.min_pixels):
         n = ctx.sp.mesh.shape[ctx.sp.axis]
@@ -442,10 +474,18 @@ def apply_unet(
     attn_cache: Optional[AttnCache] = None,
     cache_mode: str = "off",
     site_plan: Optional[Tuple[str, ...]] = None,
+    kernels=None,
 ):
     """Predict ε(x_t, t, context). Returns ``(eps, controller_store_state)``,
     plus the updated cache as a third element iff ``cache_mode='store'``
     or a ``site_plan`` is given.
+
+    ``kernels`` (a static ``kernels.KernelConfig``) routes covered
+    controller-touched sites to the fused-edit Pallas kernel — the edit
+    runs inside a tiled softmax and the probability tensor never
+    materializes in HBM (see :func:`_fused_edit_dispatch` for the exact
+    dispatch conditions). ``kernels=None`` is byte-identical to the
+    pre-existing program.
 
     With ``controller=None`` this is a plain conditional U-Net forward and the
     returned state is the input state — the `EmptyControl ≡ no controller`
@@ -514,7 +554,7 @@ def apply_unet(
         step = jnp.int32(0)
     ctx = _HookCtx(layout, controller, state, step, sp=sp,
                    attn_cache=attn_cache, cache_mode=cache_mode,
-                   site_plan=site_plan)
+                   site_plan=site_plan, kernels=kernels)
     g = cfg.groups
 
     t = jnp.broadcast_to(jnp.asarray(t), (x.shape[0],))

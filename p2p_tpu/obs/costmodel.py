@@ -457,6 +457,20 @@ def canonical_cost_cards(pipe=None, bucket: int = 1) -> Dict[str, dict]:
             f"sweep/phase2/b{bucket}",
             sweep_phase2(pipe, ctx2, carry_g, p2_g, num_steps=steps,
                          gate=gate, lower_only=True))
+        # Kernel-bearing twin (ISSUE 16): the monolithic sweep dispatched
+        # through the fused-edit kernel config, under the full-coverage
+        # store=False kernel controller the contracts trace. Compiled via
+        # the pallas interpreter (the CPU-compilable rehearsal of the same
+        # program structure), so its frozen budget pins the fused program's
+        # logical footprint next to its materialized sibling's.
+        from ..kernels import KernelConfig
+
+        kctrl = contracts_mod._kernel_controller(pipe)
+        kctrl_g = jax.tree_util.tree_map(lead, kctrl)
+        compiled_card(
+            f"sweep/kernel/b{bucket}",
+            sweep(pipe, ctx_g, lat_g, kctrl_g, num_steps=steps,
+                  lower_only=True, kernels=KernelConfig(interpret=True)))
     return cards
 
 

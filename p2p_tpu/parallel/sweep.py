@@ -31,7 +31,8 @@ from ..ops import schedulers as sched_mod
 
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
-                                   "progress", "gate", "metrics", "reuse"),
+                                   "progress", "gate", "metrics", "reuse",
+                                   "kernels"),
          donate_argnums=())
 def _sweep_jit(
     unet_params: Any,
@@ -49,6 +50,7 @@ def _sweep_jit(
     gate: Optional[int] = None,
     metrics: bool = False,
     reuse=None,
+    kernels=None,
 ):
     def one_group(ctx, lat, ctrl, ups):
         # The scanned step index is vmap-invariant (built inside the scan,
@@ -58,7 +60,7 @@ def _sweep_jit(
         lat, state = _denoise_scan(
             unet_params, cfg, layout, schedule, scheduler_kind, ctx, lat, ctrl,
             guidance_scale, uncond_per_step=ups, progress=progress, gate=gate,
-            metrics=metrics, reuse=reuse)
+            metrics=metrics, reuse=reuse, kernels=kernels)
         image = vae_mod.decode(vae_params, cfg.vae, lat.astype(jnp.float32))
         return vae_mod.to_uint8(image), lat
 
@@ -113,6 +115,7 @@ def sweep(
     metrics: bool = False,
     lower_only: bool = False,
     schedule=None,
+    kernels=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Run G independent edit groups; shard the group axis over ``dp``.
 
@@ -148,6 +151,11 @@ def sweep(
     from. Nothing is staged onto a device in this mode (the program is
     lowered mesh-less: a cost card describes the logical computation;
     the scope scales peaks by the device count separately).
+
+    ``kernels`` (a static :class:`p2p_tpu.kernels.KernelConfig`, or None)
+    routes covered controller-edited attention sites to the fused-edit
+    Pallas kernel exactly as in ``text2image`` — the edit applied inside
+    the attention tile, per group, under the same vmap-over-groups program.
     """
     cfg = pipe.config
     if layout is None:
@@ -205,7 +213,7 @@ def sweep(
             scheduler, context, latents, controllers,
             np.float32(guidance_scale), uncond_per_step,
             progress=progress, gate=gate_step, metrics=metrics,
-            reuse=reuse_sched)
+            reuse=reuse_sched, kernels=kernels)
     gs = (guidance_scale if isinstance(guidance_scale, jax.Array)
           else stage_host(np.float32(guidance_scale), mesh=mesh))
 
@@ -234,11 +242,12 @@ def sweep(
                           schedule, scheduler, context, latents, controllers,
                           gs, uncond_per_step, progress=progress,
                           gate=gate_step, metrics=metrics,
-                          reuse=reuse_sched)
+                          reuse=reuse_sched, kernels=kernels)
 
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
-                                   "progress", "gate", "metrics", "reuse"),
+                                   "progress", "gate", "metrics", "reuse",
+                                   "kernels"),
          donate_argnums=())
 def _sweep_phase1_jit(
     unet_params: Any,
@@ -254,6 +263,7 @@ def _sweep_phase1_jit(
     gate: int = 1,
     metrics: bool = False,
     reuse=None,
+    kernels=None,
 ) -> PhaseCarry:
     """The serve layer's phase-1 POOL program: steps ``[0, gate)`` of G
     groups under full CFG + controller hooks, returning the per-group
@@ -266,13 +276,14 @@ def _sweep_phase1_jit(
         return _phase1_scan(unet_params, cfg, layout, schedule,
                             scheduler_kind, ctx, lat, ctrl, guidance_scale,
                             gate=gate, progress=progress, metrics=metrics,
-                            reuse=reuse)
+                            reuse=reuse, kernels=kernels)
 
     return jax.vmap(one_group)(context, latents, controllers)
 
 
 @partial(jax.jit, static_argnames=("cfg", "layout", "scheduler_kind",
-                                   "progress", "gate", "metrics", "reuse"),
+                                   "progress", "gate", "metrics", "reuse",
+                                   "kernels"),
          donate_argnums=())
 def _sweep_phase2_jit(
     unet_params: Any,
@@ -289,6 +300,7 @@ def _sweep_phase2_jit(
     gate: int = 1,
     metrics: bool = False,
     reuse=None,
+    kernels=None,
 ):
     """The serve layer's phase-2 POOL program: steps ``[gate, S)`` of G
     hand-off carries — single-branch U-Net off the AttnCache, fixed-
@@ -300,7 +312,7 @@ def _sweep_phase2_jit(
         lat = _phase2_scan(unet_params, cfg, layout, schedule,
                            scheduler_kind, ctx_c, car, ctrl, guidance_scale,
                            gate=gate, progress=progress, metrics=metrics,
-                           reuse=reuse)
+                           reuse=reuse, kernels=kernels)
         image = vae_mod.decode(vae_params, cfg.vae, lat.astype(jnp.float32))
         return vae_mod.to_uint8(image), lat
 
@@ -351,6 +363,7 @@ def sweep_phase1(
     metrics: bool = False,
     lower_only: bool = False,
     schedule=None,
+    kernels=None,
 ) -> PhaseCarry:
     """Run phase 1 of G groups (same shapes/semantics as :func:`sweep`) and
     return the hand-off carry instead of images. ``gate`` must resolve
@@ -371,7 +384,7 @@ def sweep_phase1(
             pipe.unet_params, cfg, layout, schedule, scheduler, context,
             latents, controllers, np.float32(guidance_scale),
             progress=progress, gate=gate_step, metrics=metrics,
-            reuse=reuse_sched)
+            reuse=reuse_sched, kernels=kernels)
     if mesh is not None:
         gspec = NamedSharding(mesh, P("dp"))
         context = _stage_sharded(context, gspec)
@@ -387,7 +400,8 @@ def sweep_phase1(
         return _sweep_phase1_jit(pipe.unet_params, cfg, layout, schedule,
                                  scheduler, context, latents, controllers,
                                  gs, progress=progress, gate=gate_step,
-                                 metrics=metrics, reuse=reuse_sched)
+                                 metrics=metrics, reuse=reuse_sched,
+                                 kernels=kernels)
 
 
 def sweep_phase2(
@@ -406,6 +420,7 @@ def sweep_phase2(
     metrics: bool = False,
     lower_only: bool = False,
     schedule=None,
+    kernels=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Finish G hand-off carries: steps ``[gate, S)`` + VAE decode.
     ``controllers`` must already be the phase-2 slice
@@ -425,7 +440,7 @@ def sweep_phase2(
             pipe.unet_params, pipe.vae_params, cfg, layout, schedule,
             scheduler, context_cond, carry, controllers,
             np.float32(guidance_scale), progress=progress, gate=gate_step,
-            metrics=metrics, reuse=reuse_sched)
+            metrics=metrics, reuse=reuse_sched, kernels=kernels)
     if mesh is not None:
         gspec = NamedSharding(mesh, P("dp"))
         context_cond = _stage_sharded(context_cond, gspec)
@@ -443,7 +458,7 @@ def sweep_phase2(
                                  layout, schedule, scheduler, context_cond,
                                  carry, controllers, gs, progress=progress,
                                  gate=gate_step, metrics=metrics,
-                                 reuse=reuse_sched)
+                                 reuse=reuse_sched, kernels=kernels)
 
 
 def artifact_replay_inputs(pipe, x_t, uncond_embeddings, source: str,

@@ -205,6 +205,11 @@ def test_canonical_cards_hold_the_committed_budgets(tiny_pipe):
     # monolithic sweep, and everything costs something.
     assert 0 < cards["sweep/phase1/b1"]["flops"] < cards["sweep/b1"]["flops"]
     assert all(c["bytes_accessed"] > 0 for c in cards.values())
+    # The kernel-bearing twin (ISSUE 16) is a canonical card in its own
+    # right: frozen alongside the materialized sweep, never heavier on
+    # bytes — in-tile editing removes the probs round-trip.
+    assert 0 < cards["sweep/kernel/b1"]["bytes_accessed"] \
+        <= cards["sweep/b1"]["bytes_accessed"]
 
 
 # ---------------------------------------------------------------------------

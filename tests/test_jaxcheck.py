@@ -683,7 +683,69 @@ def test_canonical_contracts_hold_on_session_pipeline(tiny_pipe):
     # The suite must actually cover each contract class.
     kinds = {r.contract for r in results}
     assert kinds == {"no-f64", "hot-scan-callbacks", "phase2-footprint",
-                     "donation-as-declared", "trace-invisible"}
+                     "donation-as-declared", "trace-invisible",
+                     "no-materialized-probs"}
+    # ... and the kernel-bearing twins must be in the canonical sweep.
+    kernel_progs = {r.program for r in results
+                    if r.contract == "no-materialized-probs"}
+    assert kernel_progs == {"kernel/ungated-fused", "kernel/gated-fused",
+                            "kernel/serve-bucket1-fused"}
+
+
+def test_no_materialized_probs_holds_on_kernel_twins(tiny_pipe):
+    """ISSUE 16: every fused canonical twin carries ZERO CFG-doubled
+    attention-probability softmaxes, and every materialized twin (same
+    controller, ``kernels=None``) carries one per touched site — the
+    detector is never vacuous."""
+    from p2p_tpu.analysis.contracts import (_materialized_probs_eqns,
+                                            check_no_materialized_probs,
+                                            kernel_programs)
+
+    progs = kernel_programs(tiny_pipe)
+    res = check_no_materialized_probs(progs)
+    assert res and all(r.ok for r in res), [r.format() for r in res]
+    by = {p.name: p for p in progs}
+    # The full-coverage kernel controller touches all 14 TINY sites; the
+    # materialized twin softmaxes every one of them at (2B, heads, P, K).
+    assert len(_materialized_probs_eqns(by["kernel/ungated"])) == 14
+    assert _materialized_probs_eqns(by["kernel/ungated-fused"]) == []
+
+
+def test_no_materialized_probs_contract_flips_on_seeded_violation(tiny_pipe):
+    """Verdict-flip proof for the kernel contract: presenting the
+    materialized trace AS the fused program (the regression where dispatch
+    silently stops routing to the kernel) fails naming the shapes; a twin
+    that shows no probs fails as a vacuous detector; a fused program with
+    no twin fails outright."""
+    from p2p_tpu.analysis.contracts import (Program,
+                                            _kernel_controller,
+                                            _trace_denoise,
+                                            check_no_materialized_probs)
+    from p2p_tpu.kernels import KernelConfig
+
+    ctrl = _kernel_controller(tiny_pipe)
+    mat = _trace_denoise(tiny_pipe, ctrl, gate=None, metrics=False)
+    fus = _trace_denoise(tiny_pipe, ctrl, gate=None, metrics=False,
+                         kernels=KernelConfig(interpret=True))
+    b = 2
+
+    def prog(name, jaxpr):
+        return Program(name, jaxpr, group_batch=b, gate=None, metrics=False)
+
+    # Seeded violation: the "fused" program actually materializes.
+    res = check_no_materialized_probs(
+        [prog("kernel/ungated", mat), prog("kernel/ungated-fused", mat)])
+    assert len(res) == 1 and not res[0].ok
+    assert "still materializes" in res[0].detail
+    # Vacuous witness: the twin shows no probs → hard fail, not a pass.
+    res = check_no_materialized_probs(
+        [prog("kernel/ungated", fus), prog("kernel/ungated-fused", fus)])
+    assert len(res) == 1 and not res[0].ok
+    assert "vacuous" in res[0].detail
+    # Missing twin → hard fail.
+    res = check_no_materialized_probs([prog("kernel/ungated-fused", fus)])
+    assert len(res) == 1 and not res[0].ok
+    assert "no materialized twin" in res[0].detail
 
 
 def test_trace_invisible_covers_every_canonical_program(tiny_pipe):

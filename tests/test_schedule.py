@@ -547,6 +547,55 @@ def test_perfscope_sites_renders_recorded_trace(capsys):
     os.unlink(f.name)
 
 
+def test_perfscope_fuse_plan_ranks_and_feeds_kernel_config(tmp_path,
+                                                           capsys):
+    """ISSUE 16 satellite: --fuse-plan ranks sites by measured step-time
+    share × materialized-map bytes and emits exactly the artifact
+    KernelConfig.from_fuse_plan consumes."""
+    from p2p_tpu.kernels import KernelConfig
+
+    perfscope = _load_tool("perfscope")
+    trace = os.path.join(REPO, "tests", "data", "site_trace_tiny.json")
+    out = str(tmp_path / "fuse_plan.json")
+    rc = perfscope.main(["--sites", trace, "--fuse-plan", out,
+                         "--plan-config", "tiny"])
+    assert rc == 0
+    assert "wrote fuse plan" in capsys.readouterr().out
+    with open(out) as f:
+        plan = json.load(f)
+    lay = _layout()
+    assert {e["site"] for e in plan["fuse_order"]} == \
+        {R.site_name(m) for m in lay.metas}
+    scores = [e["score"] for e in plan["fuse_order"]]
+    assert scores == sorted(scores, reverse=True)
+    assert plan["dropped"] == []
+    # P=256 self sites move the biggest map AND are hottest → fuse first.
+    assert plan["fuse_order"][0]["site"].startswith("self_attn/")
+    assert plan["fuse_order"][0]["map_bytes"] == 2 * 1 * 2 * 256 * 256 * 4
+    # The artifact is directly consumable, prefix-take preserving rank.
+    kc = KernelConfig.from_fuse_plan(out, take=3)
+    assert kc.sites == tuple(e["site"] for e in plan["fuse_order"][:3])
+    assert KernelConfig.from_fuse_plan(plan).sites == \
+        tuple(e["site"] for e in plan["fuse_order"])
+    # Unmeasured layout sites rank last at share 0 (explicitly marked);
+    # trace sites the layout doesn't know are dropped LOUDLY.
+    entries = perfscope.parse_site_trace(trace)
+    partial = [e for e in entries if e["site"] != "self_attn/down0"]
+    partial.append({"site": "self_attn/down99", "share": 0.5})
+    plan2 = perfscope.fuse_plan(partial, config="tiny")
+    tail = {e["site"]: e for e in plan2["fuse_order"]}
+    assert not tail["self_attn/down0"]["measured"]
+    assert tail["self_attn/down0"]["share"] == 0.0
+    assert plan2["dropped"] == ["self_attn/down99"]
+    assert "dropped" in perfscope.render_fuse_plan(plan2)
+    # Honored-flags discipline: --fuse-plan without --sites is a usage
+    # error; an unknown preset is a loud exit 2.
+    with pytest.raises(SystemExit):
+        perfscope.main(["--fuse-plan", out])
+    assert perfscope.main(["--sites", trace, "--fuse-plan", out,
+                           "--plan-config", "nope"]) == 2
+
+
 def test_schedule_search_smoke(tmp_path, tiny_pipe):
     """Tiny-budget end-to-end search: measures the uniform baseline plus
     one relaxation, respects the eval cap, and emits a valid artifact

@@ -53,6 +53,12 @@ HEADLINE_KEYS: Tuple[Tuple[str, str, str], ...] = (
     # headline; ≥1.5× is the ISSUE target, vs 1.41× for the single
     # gate). Missing in pre-schedule rounds → n/a per the contract.
     ("gate.schedule.speedup", "x", "higher"),
+    # ISSUE 16: the fused in-kernel-edit attention's speedup over the
+    # materialized reference at the same operating point. Only meaningful
+    # on chip (CPU rehearsal runs the pallas INTERPRETER — the sub-record
+    # carries `interpret: true` there); missing in pre-kernel rounds →
+    # n/a per the contract.
+    ("gate.kernel.speedup", "x", "higher"),
     ("serve.p95_ms", "ms", "lower"),
     ("serve.phases.two_pool_p95_ms", "ms", "lower"),
     ("serve.mesh.imgs_per_s_per_device", "img/s/device", "higher"),

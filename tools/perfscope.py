@@ -17,6 +17,10 @@ peak table, measured MFU, and the frozen-budget diff the quality gate's
                                                # (deliberate regeneration)
     python tools/perfscope.py --programs F     # render a serve
                                                # --programs-out artifact
+    python tools/perfscope.py --sites TRACE --fuse-plan out.json
+                                               # rank sites fuse-first
+                                               # (share x map bytes) for
+                                               # KernelConfig.from_fuse_plan
     python tools/perfscope.py --json out.json  # structured report
 
 ``--headline`` recomputes "89 TF/s ≈ 45% MFU at 40.75 ms/step" from the
@@ -148,6 +152,58 @@ def parse_site_trace(path: str) -> list:
             for s in sorted(durs, key=lambda s: -durs[s])]
 
 
+def fuse_plan(entries: list, config: str = "sd14",
+              group_batch: int = 1) -> dict:
+    """Rank attention sites fuse-first (ISSUE 16): measured step-time share
+    (a ``--sites`` trace table) × the bytes the materialized probability
+    map moves per step (``2B·heads·P·K·4``, the f32 softmax the fused-edit
+    kernel keeps in VMEM). The product is the roofline-weighted payoff of
+    fusing that site: a site that is both hot on the trace AND moves a big
+    map fuses first. ``group_batch`` is B (prompts per edit group; the 2×
+    is CFG). Sites the layout knows but the trace never measured rank last
+    at share 0 (explicitly ``measured: false`` — taking the whole list
+    still fuses them); trace sites unknown to ``config``'s layout are
+    dropped LOUDLY in the returned ``dropped`` list, never silently.
+    The emitted ``fuse_order`` is exactly what
+    ``kernels.KernelConfig.from_fuse_plan`` consumes."""
+    from p2p_tpu.engine.reuse import site_name
+    from p2p_tpu.models.config import PRESET_CONFIGS, unet_layout
+
+    if config not in PRESET_CONFIGS:
+        raise ValueError(f"unknown --plan-config {config!r} "
+                         f"(one of {sorted(PRESET_CONFIGS)})")
+    metas = {site_name(m): m
+             for m in unet_layout(PRESET_CONFIGS[config].unet).metas}
+    shares = {e["site"]: e["share"] for e in entries}
+    dropped = sorted(set(shares) - set(metas))
+    order = []
+    for name, m in metas.items():
+        share = shares.get(name, 0.0)
+        map_bytes = 2 * group_batch * m.heads * m.pixels * m.key_len * 4
+        order.append({"site": name, "share": share,
+                      "map_bytes": map_bytes,
+                      "score": share * map_bytes,
+                      "measured": name in shares})
+    order.sort(key=lambda d: (-d["score"], -d["map_bytes"]))
+    return {"config": config, "group_batch": group_batch,
+            "fuse_order": order, "dropped": dropped}
+
+
+def render_fuse_plan(plan: dict) -> str:
+    lines = [f"  {'site':22s} {'share':>7s} {'map MiB':>9s} "
+             f"{'score':>10s}"]
+    for e in plan["fuse_order"]:
+        mark = "" if e["measured"] else "  (unmeasured)"
+        lines.append(f"  {e['site']:22s} {e['share'] * 100:>6.1f}% "
+                     f"{e['map_bytes'] / 2**20:>9.2f} "
+                     f"{e['score']:>10.3g}{mark}")
+    if plan["dropped"]:
+        lines.append(f"  dropped {len(plan['dropped'])} trace site(s) not "
+                     f"in the {plan['config']!r} layout: "
+                     f"{', '.join(plan['dropped'])}")
+    return "\n".join(lines)
+
+
 def render_sites(entries: list) -> str:
     lines = [f"  {'site':22s} {'dur ms':>10s} {'slices':>7s} {'share':>7s}"]
     for e in entries:
@@ -198,6 +254,18 @@ def main(argv=None) -> int:
                          "trace (named_scope site names) — the reuse-"
                          "schedule search's seed input "
                          "(tools/schedule_search.py --sites-json)")
+    ap.add_argument("--fuse-plan", default=None, metavar="FILE",
+                    help="with --sites: write the ranked fuse-first site "
+                         "list (measured step-time share × materialized-"
+                         "map bytes) to FILE — the artifact "
+                         "kernels.KernelConfig.from_fuse_plan consumes")
+    ap.add_argument("--plan-config", default="sd14", metavar="NAME",
+                    help="model preset whose attention layout prices the "
+                         "--fuse-plan map bytes (default: sd14)")
+    ap.add_argument("--group-batch", type=int, default=1, metavar="B",
+                    help="prompts per edit group for the --fuse-plan map "
+                         "bytes (the 2x CFG doubling is applied on top; "
+                         "default: 1)")
     ap.add_argument("--budgets", default=None, metavar="FILE",
                     help="budgets file (default: tools/cost_budgets.json)")
     ap.add_argument("--json", default=None, metavar="FILE",
@@ -222,6 +290,9 @@ def main(argv=None) -> int:
                        or args.update_budgets or args.check_budgets):
         ap.error("--sites renders a recorded trace; it takes none of "
                  "--programs/--headline/--check-budgets/--update-budgets")
+    if args.fuse_plan and not args.sites:
+        ap.error("--fuse-plan ranks measured sites; it needs --sites "
+                 "TRACE (the recorded device trace to price)")
 
     report: dict = {}
     rc = 0
@@ -235,6 +306,22 @@ def main(argv=None) -> int:
         print(f"{len(entries)} attention site(s) from {args.sites}")
         print(render_sites(entries))
         report["sites"] = entries
+        if args.fuse_plan:
+            try:
+                plan = fuse_plan(entries, config=args.plan_config,
+                                 group_batch=args.group_batch)
+            except ValueError as e:
+                print(f"--fuse-plan: {e}", file=sys.stderr)
+                return 2
+            print(render_fuse_plan(plan))
+            os.makedirs(os.path.dirname(args.fuse_plan) or ".",
+                        exist_ok=True)
+            with open(args.fuse_plan, "w") as f:
+                json.dump(plan, f, indent=2)
+                f.write("\n")
+            print(f"wrote fuse plan: {args.fuse_plan} "
+                  f"({len(plan['fuse_order'])} site(s) ranked)")
+            report["fuse_plan"] = plan
     elif args.programs:
         entries = []
         with open(args.programs) as f:

@@ -23,6 +23,7 @@ import argparse
 import os
 import sys
 import time
+import warnings
 
 # Force the deterministic CPU backend before any jax import: quality is
 # platform-independent, and the goldens are pinned on CPU (same shared
@@ -208,6 +209,89 @@ def _serve_parity():
                    - np.asarray(want).astype(np.int16))
         worst = max(worst, int(d.max()))
     return worst
+
+
+def _kernel_parity():
+    """The fused-kernel numerics contract (ISSUE 16): interpret-mode fused
+    attention (``KernelConfig(interpret=True)``) vs the reference
+    ``attention_probs`` materialized path, end to end through
+    ``text2image`` on the seeded tiny config.
+
+    Legs:
+
+    1. **non-edit bitwise** — with no controller every site takes the
+       library flash path whether or not a KernelConfig rides the call, so
+       images and latents must be bit-identical: the dispatch layer itself
+       is program-invisible.
+    2. **per edit family** — replace / refine / reweight controllers
+       (store=False so every touched site actually fuses), plus a gated
+       store=True run that exercises the *store* (phase-1 flash side
+       output) and *use* (phase-2 cached maps) variants. Each family runs
+       fused vs materialized; latent MSE must stay inside the drift
+       budget. A static ``site_variant`` census per family guards against
+       the leg going vacuous (zero fused sites would pass trivially).
+
+    Observed parity on the pinning host is exactly 0.0 for every family
+    (the kernel reproduces softmax→edit→PV in f32), so the default budget
+    has orders-of-magnitude headroom."""
+    import jax
+
+    from p2p_tpu.align.words import get_equalizer
+    from p2p_tpu.controllers import factory
+    from p2p_tpu.engine.sampler import text2image
+    from p2p_tpu.kernels import KernelConfig
+    from p2p_tpu.kernels.dispatch import VARIANT_FUSED, site_variant
+    from p2p_tpu.models import TINY
+    from p2p_tpu.models.config import unet_layout
+    from tests.test_golden import _pipe
+
+    pipe = _pipe(TINY)
+    tok = pipe.tokenizer
+    steps, seed = 3, 42
+    prompts = ["a squirrel eating a burger", "a squirrel eating a lasagna"]
+    kc = KernelConfig(interpret=True)
+    layout = unet_layout(TINY.unet)
+    rng = jax.random.PRNGKey(seed)
+
+    def run(ctrl, gate=None, kernels=None):
+        with warnings.catch_warnings():
+            # The gated store+use family intentionally gates inside the
+            # controller's edit window; the truncation advisory is expected.
+            warnings.simplefilter("ignore", UserWarning)
+            img, xt, _ = text2image(pipe, prompts, ctrl, num_steps=steps,
+                                    rng=rng, gate=gate, kernels=kernels)
+        return (np.asarray(img).astype(np.int16),
+                np.asarray(xt, dtype=np.float64))
+
+    img0, xt0 = run(None)
+    img1, xt1 = run(None, kernels=kc)
+    bitwise = bool(np.array_equal(img0, img1) and np.array_equal(xt0, xt1))
+
+    size = pipe.config.unet.sample_size
+    kw = dict(tokenizer=tok, max_len=pipe.config.text.max_length,
+              self_max_pixels=size * size)
+    eq = get_equalizer(prompts[0], ["burger"], [3.0], tok, mode="paired")
+    families = {
+        "replace": (factory.attention_replace(
+            prompts, steps, 0.8, 0.4, store=False, **kw), None),
+        "refine": (factory.attention_refine(
+            prompts, steps, 0.8, 0.4, store=False, **kw), None),
+        "reweight": (factory.attention_reweight(
+            prompts, steps, 0.8, 0.4, eq, store=False, **kw), None),
+        "store+use": (factory.attention_replace(
+            prompts, steps, 0.8, 0.4, store=True, **kw), 0.5),
+    }
+    results = {}
+    for name, (ctrl, gate) in families.items():
+        fused_sites = sum(
+            1 for m in layout.metas
+            if site_variant(kc, ctrl, m, "off") == VARIANT_FUSED)
+        img_r, xt_r = run(ctrl, gate=gate)
+        img_f, xt_f = run(ctrl, gate=gate, kernels=kc)
+        mse = float(((xt_f - xt_r) ** 2).mean())
+        mx = int(np.abs(img_f - img_r).max())
+        results[name] = (fused_sites, mse, mx)
+    return bitwise, results
 
 
 def _mesh_parity():
@@ -636,6 +720,15 @@ def main(argv=None) -> int:
                     help="max per-pixel abs diff for the serve-path parity "
                          "check (default 0: serving must be bitwise "
                          "numerics-neutral)")
+    ap.add_argument("--skip-kernel", action="store_true",
+                    help="skip the fused-kernel parity leg (interpret-mode "
+                         "fused attention vs the materialized reference "
+                         "path, per edit family)")
+    ap.add_argument("--kernel-mse", type=float, default=1e-6,
+                    metavar="B",
+                    help="latent-MSE budget per edit family for the "
+                         "kernel_parity leg (default %(default)s; observed "
+                         "parity is exactly 0.0 on the pinning host)")
     ap.add_argument("--skip-obs", action="store_true",
                     help="skip the telemetry-overhead check")
     ap.add_argument("--skip-mesh", action="store_true",
@@ -710,14 +803,15 @@ def main(argv=None) -> int:
                                        "static_analysis", "flight_parity",
                                        "bench_trend", "lifecycle", "soak",
                                        "mesh_parity", "slo", "cache_parity",
-                                       "cost_regression", "schedule"}
+                                       "cost_regression", "schedule",
+                                       "kernel_parity"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
                      f"valid: {', '.join(cases)}, phase_gate, serve_parity, "
                      f"obs_overhead, fault_drill, static_analysis, "
                      f"flight_parity, bench_trend, lifecycle, soak, "
                      f"mesh_parity, slo, cache_parity, cost_regression, "
-                     f"schedule")
+                     f"schedule, kernel_parity")
 
     drifted = []
     for name, fn in cases.items():
@@ -772,6 +866,22 @@ def main(argv=None) -> int:
               f"{'ok' if ok else 'DRIFT'}")
         if not ok:
             drifted.append("serve_parity")
+
+    if not args.skip_kernel and (only is None or "kernel_parity" in only):
+        bitwise, fam = _kernel_parity()
+        vacuous = [n for n, (sites, _, _) in fam.items() if sites == 0]
+        worst = max(mse for _, mse, _ in fam.values())
+        ok = bitwise and not vacuous and worst <= args.kernel_mse
+        detail = ", ".join(f"{n}: {sites} fused mse={mse:.3g} "
+                           f"max|Δ|={mx}" for n, (sites, mse, mx)
+                           in fam.items())
+        print(f"{'kernel_parity':16s} non-edit "
+              f"{'bitwise' if bitwise else 'DIFF'}; {detail} "
+              f"{'ok' if ok else 'DRIFT'}")
+        if vacuous:
+            print(f"  vacuous families (0 fused sites): {vacuous}")
+        if not ok:
+            drifted.append("kernel_parity")
 
     if not args.skip_flight and (only is None or "flight_parity" in only):
         rec_id, img_id, n_flights, n_attr, chain = _flight_parity()
