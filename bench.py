@@ -1193,6 +1193,52 @@ def _measure(preset):
             extras["serve"]["cache"] = _load_tool(
                 "chaos_drill").cache_parity_drill(pipe)
 
+            # Production profiling (ISSUE 18): re-serve the headline
+            # rehearsal trace with a ProdScope attached — sampled device
+            # captures into a bounded trace ring, folded into the
+            # workload-profile ledger — and record what it observed and
+            # what it cost. overhead_pct is capture wall time over
+            # non-capture serve wall time as the profiler itself
+            # accounts it: honest but scale-dependent. At CPU-rehearsal
+            # dispatch durations the trace start/stop + parse dominates,
+            # so the number sits far above what 1/N sampling costs on
+            # multi-second device dispatches — the benchwatch trend
+            # (serve.profile.overhead_pct, lower is better) is the
+            # regression signal, not the absolute value.
+            import tempfile
+
+            from p2p_tpu.obs.prodscope import ProdScope
+
+            with tempfile.TemporaryDirectory() as ptmp:
+                scope = ProdScope(os.path.join(ptmp, "profile"),
+                                  seed=0, period=4,
+                                  tags={"preset": "tiny",
+                                        "bench": "serve_rehearsal"})
+                reqs_p = [Request.from_dict(d) for d in trace_dicts]
+                ok_p = 0
+                s_prof = None
+                for rec in serve_forever(pipe, reqs_p, max_batch=4,
+                                         max_wait_ms=100.0,
+                                         prewarm=reqs_p[:1],
+                                         prodscope=scope):
+                    if rec["status"] == "ok":
+                        ok_p += 1
+                    elif rec["status"] == "summary":
+                        s_prof = rec
+                if ok_p != n:
+                    raise RuntimeError(
+                        f"serve profile leg served {ok_p}/{n} "
+                        f"(counts: {s_prof and s_prof['counts']})")
+                prof = s_prof["profile"]
+                extras["serve"]["profile"] = {
+                    "captures": prof["captures"],
+                    "sampled_1_in": 4,
+                    "sites_measured": prof["sites_measured"],
+                    "ledger_bytes": prof["ledger_bytes"],
+                    "overhead_pct": round(prof["overhead_pct"], 1),
+                    "drift_events": prof["drift_events"],
+                }
+
         # Telemetry-overhead block (ISSUE 3): the same headline single-group
         # edit run with the obs instrumentation enabled (phase-tagged step
         # callbacks traced in, host collector installed) vs disabled, so

@@ -483,7 +483,6 @@ def cmd_serve(args) -> int:
     from .serve import (DegradeConfig, DrainController, FaultPlan, Journal,
                         Request, parse_jsonl_line, parse_mesh,
                         serve_forever, signal_drain)
-    from .utils.progress import trace as prof_trace
 
     if args.snapshot_every_ms is not None and not args.journal:
         # Fail fast, before the (expensive) pipeline build.
@@ -527,6 +526,31 @@ def cmd_serve(args) -> int:
 
         costscope = obs_costmodel.CostScope()
     default_sched = _schedule_spec(args)
+    prodscope = None
+    if args.profile:
+        from .obs import prodscope as obs_prodscope
+
+        tags = {"preset": args.preset, "max_batch": args.max_batch}
+        if args.mesh:
+            tags["mesh"] = args.mesh
+        if args.phase2_max_batch is not None:
+            tags["phase2_max_batch"] = args.phase2_max_batch
+        if default_sched is not None:
+            tags["schedule"] = default_sched
+        try:
+            prodscope = obs_prodscope.ProdScope(
+                args.profile, seed=args.profile_seed,
+                period=args.profile_every,
+                ring_max_bytes=args.profile_ring_bytes,
+                ring_max_count=args.profile_ring_count, tags=tags)
+        except ValueError as e:
+            raise SystemExit(f"--profile: {e}")
+    elif (args.profile_every != 8 or args.profile_seed != 0
+          or args.profile_ring_bytes != 256 << 20
+          or args.profile_ring_count != 16):
+        raise SystemExit("--profile-every/--profile-seed/--profile-ring-"
+                         "bytes/--profile-ring-count configure the "
+                         "production profiler: they need --profile DIR")
     pipe = _build_pipeline(args)
     stream = sys.stdin if args.requests == "-" else open(args.requests)
     items = []
@@ -586,6 +610,11 @@ def cmd_serve(args) -> int:
                   "the insert window needs --cache AND --journal — the "
                   "kill never fires and the durability path is NOT being "
                   "drilled", file=sys.stderr)
+        if "kill_during_capture" in kinds and not args.profile:
+            print("warning: chaos plan arms 'kill_during_capture' but "
+                  "--profile is off — there is no capture to die inside "
+                  "and the orphan-sweep path is NOT being drilled",
+                  file=sys.stderr)
     degrade = None
     if args.degrade_depth is not None:
         degrade = DegradeConfig(depth_threshold=args.degrade_depth,
@@ -650,7 +679,7 @@ def cmd_serve(args) -> int:
     drain_ctl = DrainController()
     interrupted = False
     try:
-        with prof_trace(args.profile), signal_drain(drain_ctl):
+        with signal_drain(drain_ctl):
             for rec in serve_forever(
                     pipe, items, max_batch=args.max_batch,
                     max_wait_ms=args.max_wait_ms, queue_cap=args.queue_cap,
@@ -666,6 +695,7 @@ def cmd_serve(args) -> int:
                     slo=slo,
                     semcache=semcache,
                     costscope=costscope,
+                    prodscope=prodscope,
                     flight=flight_tracer,
                     lifecycle=drain_ctl,
                     snapshot_every_ms=args.snapshot_every_ms,
@@ -680,6 +710,16 @@ def cmd_serve(args) -> int:
             journal.close()
         if out is not sys.stdout:
             out.close()
+        if prodscope is not None:
+            # Written in the finally so a fatal drain's captures still
+            # persist their ledger.
+            try:
+                path = prodscope.write_ledger()
+            except OSError as e:
+                print(f"--profile: ledger write failed: {e}",
+                      file=sys.stderr)
+            else:
+                print(f"wrote {path}", file=sys.stderr)
         if costscope is not None and args.programs_out:
             # Written in the finally so a fatal drain's cards (and a
             # partially-drained trace) still produce the artifact.
@@ -787,7 +827,7 @@ def build_parser() -> argparse.ArgumentParser:
     # Each subcommand declares exactly the flags it honors — no
     # accepted-but-ignored options (the reference's unread `--path
     # config.yaml`, `/root/reference/main.py:388`, is the anti-pattern).
-    def model_opts(sp, guidance=True, metrics=True):
+    def model_opts(sp, guidance=True, metrics=True, profile=True):
         # Literal name tuples: build_parser must stay jax-free so --help and
         # argparse errors are instant. Drift against the canonical
         # PRESET_CONFIGS map is pinned by
@@ -808,8 +848,13 @@ def build_parser() -> argparse.ArgumentParser:
             sp.add_argument("--guidance", type=float, default=7.5)
         sp.add_argument("--quiet", action="store_true",
                         help="suppress per-step progress output")
-        sp.add_argument("--profile", default=None, metavar="DIR",
-                        help="write a jax.profiler trace of the run to DIR")
+        if profile:
+            # serve defines its own --profile (the production profiler's
+            # ring + ledger directory, ISSUE 18) — a whole-run
+            # jax.profiler trace of a server is the wrong tool there.
+            sp.add_argument("--profile", default=None, metavar="DIR",
+                            help="write a jax.profiler trace of the run "
+                                 "to DIR")
         if metrics:
             # serve surfaces its own --metrics-out/--events-out pair (the
             # registry there also carries queue/batcher/cache families).
@@ -920,7 +965,7 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser(
         "serve",
         help="request-level serving: JSONL requests in, JSONL records out")
-    model_opts(s, guidance=False, metrics=False)
+    model_opts(s, guidance=False, metrics=False, profile=False)
     s.add_argument("--requests", required=True,
                    help="JSONL request trace: a file, a FIFO, or '-' for "
                         "stdin (schema: docs/SERVING.md; generator: "
@@ -1116,6 +1161,34 @@ def build_parser() -> argparse.ArgumentParser:
                    help="in-memory byte budget for the exact-result layer "
                         "(LRU; eviction deletes the spill too; "
                         "default 256 MiB)")
+    s.add_argument("--profile", default=None, metavar="DIR",
+                   help="enable in-engine sampled device profiling "
+                        "(ISSUE 18, docs/OBSERVABILITY.md#production-"
+                        "profiling): every Nth dispatch (deterministic, "
+                        "seeded, per-pool) runs under a programmatic "
+                        "jax.profiler capture into a bounded trace ring "
+                        "under DIR; captures fold into DIR/"
+                        "workload_profile.json — the measured seed "
+                        "artifact tools/schedule_search.py --profile and "
+                        "tools/perfscope.py --sites consume — and EWMA "
+                        "drift sentinels journal profile_drift events. "
+                        "Off (the default), records, journal and "
+                        "programs are byte-identical")
+    s.add_argument("--profile-every", type=int, default=8, metavar="N",
+                   help="sampling period: capture ~1 of every N "
+                        "dispatches per pool (hash-mod on the seeded "
+                        "plan, so the sampled set is reproducible; "
+                        "default 8; 1 captures everything)")
+    s.add_argument("--profile-seed", type=int, default=0, metavar="S",
+                   help="sampling-plan seed (same seed => same sampled "
+                        "dispatch set; default 0)")
+    s.add_argument("--profile-ring-bytes", type=int, default=256 << 20,
+                   metavar="B",
+                   help="trace-ring size cap: oldest committed captures "
+                        "are evicted past it (default 256 MiB)")
+    s.add_argument("--profile-ring-count", type=int, default=16,
+                   metavar="N",
+                   help="trace-ring count cap (default 16 captures)")
     s.set_defaults(fn=cmd_serve)
 
     c = sub.add_parser(

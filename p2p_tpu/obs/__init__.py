@@ -28,6 +28,16 @@ docs/OBSERVABILITY.md for the metric catalog and span taxonomy):
   hook. Imported explicitly for the same jax-at-import reason as
   ``device`` (jax only inside functions, but its consumers are all
   jax-side).
+- :mod:`.traceparse` — shared chrome-trace / WorkloadProfile parsing
+  (ISSUE 18): the ``perfscope --sites`` named_scope fold, the HLO
+  op→site index that recovers measured per-site shares from bare-op
+  traces, and the ledger format helpers. Stdlib-only; safe from tools.
+- :mod:`.prodscope` — in-engine sampled device profiling (ISSUE 18):
+  the deterministic sampling plan, the bounded on-disk trace ring, the
+  mergeable WorkloadProfile ledger and the EWMA drift sentinels behind
+  ``serve --profile``. Imported explicitly (``from p2p_tpu.obs import
+  prodscope``) — module import is jax-free, but capture methods pull
+  jax, and its only consumer is the serve engine.
 
 The TPU-native discipline: disabling telemetry traces *nothing* into any
 XLA program (the ``emit_step(enabled=False)`` contract, pinned by jaxpr

@@ -263,8 +263,8 @@ class FlightTracer:
 
     # -- the flight recorder -----------------------------------------------
 
-    def blackbox(self, reason: str, state: Optional[dict] = None) -> \
-            Optional[str]:
+    def blackbox(self, reason: str, state: Optional[dict] = None,
+                 extras: Optional[dict] = None) -> Optional[str]:
         """Dump a post-mortem bundle (no-op without ``blackbox_dir``):
 
         - ``state.json``   — the dump reason, the engine's pool/queue
@@ -273,6 +273,11 @@ class FlightTracer:
           truncated view is detectable)
         - ``inflight.jsonl`` — one line per open flight context
         - ``flights.jsonl``  — the flight records finished before the dump
+        - one ``<name>.json`` per ``extras`` entry — sidecar context
+          other subsystems attach at the dump site (ISSUE 18: the
+          production profiler's latest workload-profile snapshot and
+          active sampling plan, so a FATAL verdict ships with the
+          performance context that preceded it)
 
         Bundles are numbered (``000_watchdog_timeout/``...) so repeated
         incidents in one run never clobber each other. Returns the bundle
@@ -296,6 +301,11 @@ class FlightTracer:
         with open(os.path.join(bundle, "flights.jsonl"), "w") as f:
             for rec in self.records:
                 f.write(json.dumps(rec) + "\n")
+        for name, doc in (extras or {}).items():
+            slug = "".join(c if c.isalnum() else "_" for c in name[:40])
+            with open(os.path.join(bundle, f"{slug}.json"), "w") as f:
+                json.dump(doc, f, indent=1)
+                f.write("\n")
         self.blackbox_bundles.append(bundle)
         return bundle
 

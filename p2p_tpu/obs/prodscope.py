@@ -1,0 +1,881 @@
+"""prodscope — in-engine sampled device profiling (ISSUE 18).
+
+The production half of the observability story: ``perfscope`` (ISSUE 14)
+prices programs *analytically* and the schedule search (ISSUE 15) wants
+*measured* per-site tables — but until now those came only from a
+hand-collected Chrome trace. This module closes the loop inside the
+serve engine:
+
+- :class:`SamplingPlan` — deterministic, seeded per-pool dispatch
+  sampling (a stable hash of ``(seed, pool, ordinal)``; same seed ⇒ the
+  same sampled dispatch set, independent of wall clock or arrival
+  jitter).
+- :class:`TraceRing` — a bounded on-disk ring of ``jax.profiler``
+  capture artifacts: size- and count-capped, written atomically
+  (tmp dir → ``os.replace``), orphans from a crash mid-capture swept at
+  startup and GC'd like carry spills. Each committed capture carries a
+  ``meta.json`` tagging the dispatch's program label, pool, bucket,
+  schedule table, kernel config, mesh spec and the device-memory gauges
+  at the capture point.
+- :class:`ProdScope` — the engine sidecar: ``begin``/``stop`` bracket
+  every dispatch (sampled ones run under a programmatic profiler
+  capture), ``finalize`` folds stopped captures — at the batch-boundary
+  sync, never inside the dispatch ``try`` (a fold error must not be
+  classified as a dispatch fault) — through the shared
+  :mod:`.traceparse` parser into a durable, mergeable
+  :data:`~p2p_tpu.obs.traceparse.PROFILE_FORMAT` WorkloadProfile
+  ledger, and runs the EWMA drift sentinels over each capture.
+- :func:`fold_profiles` — the ledger merge (commutative and
+  associative; pinned by tests/test_prodscope.py), which is also how a
+  restart extends the previous incarnation's ledger instead of
+  clobbering it.
+
+Disabled-mode discipline (PR-3/7/14): with ``prodscope=None`` the
+engine's record stream, journal bytes, compiled programs and metric
+families are byte-identical — every metric family here registers in
+``__init__``, overhead accounting uses the scope's own
+``time.perf_counter`` (never the engine's injected timer), and profile
+facts live only in the ledger, the summary ``profile`` block and
+journaled ``profile_drift`` events.
+
+jax is imported lazily inside capture methods only, so the module (and
+its fold/plan/ring units) stays importable backend-free.
+"""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import hashlib
+import json
+import os
+import shutil
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import metrics as metrics_mod
+from . import traceparse
+
+PROFILE_FORMAT = traceparse.PROFILE_FORMAT
+
+#: The ledger file a scope maintains under its output directory.
+LEDGER_NAME = "workload_profile.json"
+
+#: Registry histogram families snapshotted into the ledger (the
+#: queue/batcher stage timings the autotuner correlates site shares
+#: against).
+STAGE_FAMILIES = ("serve_queue_wait_ms", "serve_run_ms",
+                  "serve_compile_ms", "serve_request_total_ms",
+                  "serve_batch_occupancy")
+
+
+class SamplingPlan:
+    """Deterministic per-pool dispatch sampling: dispatch ``ordinal`` of
+    ``pool`` is sampled iff ``sha1(seed:pool:ordinal) % period == 0`` —
+    seeded, independent of wall time, and stable across restarts (the
+    determinism contract the ledger's provenance rests on)."""
+
+    def __init__(self, seed: int = 0, period: int = 8):
+        if period < 1:
+            raise ValueError(f"sampling period must be >= 1, got {period}")
+        self.seed = int(seed)
+        self.period = int(period)
+
+    def sampled(self, pool: str, ordinal: int) -> bool:
+        if self.period == 1:
+            return True
+        h = hashlib.sha1(
+            f"{self.seed}:{pool}:{ordinal}".encode()).digest()
+        return int.from_bytes(h[:8], "big") % self.period == 0
+
+    def describe(self) -> dict:
+        return {"kind": "hash-mod", "seed": self.seed,
+                "period": self.period}
+
+
+class TraceRing:
+    """Bounded on-disk ring of committed capture directories.
+
+    Layout: ``<root>/cap-<seq:06d>/`` per committed capture (profiler
+    output + ``meta.json``), ``<root>/tmp-cap-<seq:06d>/`` while a
+    capture is in flight. Commit is a single ``os.replace`` — a crash
+    mid-capture leaves only a ``tmp-cap-*`` orphan, swept (and counted)
+    on the next startup, exactly the carry-spill GC discipline. GC
+    evicts oldest-first past either cap but always keeps the newest
+    committed capture."""
+
+    TMP_PREFIX = "tmp-cap-"
+    CAP_PREFIX = "cap-"
+
+    def __init__(self, root: str, max_bytes: int = 256 << 20,
+                 max_count: int = 16):
+        if max_count < 1:
+            raise ValueError(f"ring max_count must be >= 1, "
+                             f"got {max_count}")
+        if max_bytes < 1:
+            raise ValueError(f"ring max_bytes must be >= 1, "
+                             f"got {max_bytes}")
+        self.root = root
+        self.max_bytes = int(max_bytes)
+        self.max_count = int(max_count)
+        os.makedirs(root, exist_ok=True)
+
+    def sweep_orphans(self) -> int:
+        """Delete crash-orphaned tmp capture dirs; returns the count."""
+        n = 0
+        for d in sorted(glob_mod.glob(
+                os.path.join(self.root, self.TMP_PREFIX + "*"))):
+            shutil.rmtree(d, ignore_errors=True)
+            n += 1
+        return n
+
+    def next_seq(self) -> int:
+        seqs = [0]
+        for d in self.captures():
+            name = os.path.basename(d)[len(self.CAP_PREFIX):]
+            try:
+                seqs.append(int(name.split("-")[0]) + 1)
+            except ValueError:
+                pass
+        return max(seqs)
+
+    def tmp_dir(self, seq: int) -> str:
+        path = os.path.join(self.root, f"{self.TMP_PREFIX}{seq:06d}")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def commit(self, tmpdir: str, seq: int) -> str:
+        """Atomically promote a finished tmp capture into the ring."""
+        final = os.path.join(self.root, f"{self.CAP_PREFIX}{seq:06d}")
+        os.replace(tmpdir, final)
+        return final
+
+    def captures(self) -> List[str]:
+        return sorted(glob_mod.glob(
+            os.path.join(self.root, self.CAP_PREFIX + "*")))
+
+    @staticmethod
+    def _dir_bytes(d: str) -> int:
+        total = 0
+        for base, _, files in os.walk(d):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(base, f))
+                except OSError:
+                    pass
+        return total
+
+    def gc(self) -> Tuple[int, int]:
+        """Evict oldest captures past either cap (the newest always
+        survives, even when one capture alone exceeds ``max_bytes``).
+        Returns ``(evicted, bytes_freed)``."""
+        caps = self.captures()
+        sizes = {d: self._dir_bytes(d) for d in caps}
+        evicted = freed = 0
+        while len(caps) > 1 and (
+                len(caps) > self.max_count
+                or sum(sizes[d] for d in caps) > self.max_bytes):
+            victim = caps.pop(0)
+            shutil.rmtree(victim, ignore_errors=True)
+            evicted += 1
+            freed += sizes.pop(victim)
+        return evicted, freed
+
+    def stats(self) -> dict:
+        caps = self.captures()
+        return {"count": len(caps),
+                "bytes": sum(self._dir_bytes(d) for d in caps),
+                "max_count": self.max_count,
+                "max_bytes": self.max_bytes}
+
+
+class DriftSentinel:
+    """EWMA drift detector over one signal family, keyed by program or
+    site. An observation fires an event when it deviates from the
+    pre-update EWMA by more than ``threshold`` (relative) — but only
+    after ``min_samples`` observations of that key, so short parity runs
+    never emit journal lines (the byte-identical-off contract's quiet
+    half)."""
+
+    def __init__(self, kind: str, alpha: float = 0.3,
+                 threshold: float = 0.25, min_samples: int = 3):
+        self.kind = kind
+        self.alpha = float(alpha)
+        self.threshold = float(threshold)
+        self.min_samples = int(min_samples)
+        self._state: Dict[str, dict] = {}
+        self.last_deviation = 0.0
+
+    def observe(self, key: str, value: float) -> Optional[dict]:
+        st = self._state.get(key)
+        if st is None:
+            self._state[key] = {"ewma": float(value), "n": 1}
+            return None
+        ewma = st["ewma"]
+        st["n"] += 1
+        deviation = abs(value - ewma) / max(abs(ewma), 1e-9)
+        st["ewma"] = ewma + self.alpha * (value - ewma)
+        self.last_deviation = deviation
+        if st["n"] > self.min_samples and deviation > self.threshold:
+            return {"drift": self.kind, "key": key,
+                    "value": round(float(value), 4),
+                    "ewma": round(ewma, 4),
+                    "deviation": round(deviation, 4),
+                    "threshold": self.threshold}
+        return None
+
+
+# -- the WorkloadProfile ledger ------------------------------------------
+
+
+def empty_profile(tags: Optional[dict] = None) -> dict:
+    return {
+        "format": PROFILE_FORMAT,
+        "version": 1,
+        "tags": dict(tags or {}),
+        "window": {"first_vnow_ms": None, "last_vnow_ms": None, "runs": 0},
+        "captures": {"count": 0, "dispatches_seen": 0, "events_folded": 0},
+        "sites": [],
+        "programs": [],
+        "phases": {},
+        "kernels": [],
+        "schedule_segments": [],
+        "stage_histograms": {},
+        "device_memory": {},
+        "drift": {"events": 0, "by_kind": {}},
+        "overhead": {"capture_ms": 0.0, "base_wall_ms": 0.0,
+                     "overhead_pct": 0.0},
+    }
+
+
+def _fold_tags(a: dict, b: dict) -> dict:
+    """Key-wise merge; conflicting values collapse to a sorted
+    ``{"mixed": [...]}`` set so the fold stays commutative AND
+    associative (mixed sets union, never nest)."""
+    def variants(v) -> List[str]:
+        if isinstance(v, dict) and set(v) == {"mixed"}:
+            return list(v["mixed"])
+        return [json.dumps(v, sort_keys=True)]
+
+    out = {}
+    for key in sorted(set(a) | set(b)):
+        if key in a and key in b:
+            vs = sorted(set(variants(a[key])) | set(variants(b[key])))
+            out[key] = (json.loads(vs[0]) if len(vs) == 1
+                        else {"mixed": vs})
+        else:
+            out[key] = a.get(key, b.get(key))
+    return out
+
+
+def _sum_keyed(a: List[dict], b: List[dict], key_fields: Tuple[str, ...],
+               sum_fields: Tuple[str, ...],
+               keep_fields: Tuple[str, ...] = ()) -> List[dict]:
+    """Merge two entry lists by a key tuple, summing the numeric fields.
+    ``keep_fields`` resolve conflicts by max (they are expected equal —
+    e.g. a program's flops — and max is commutative/associative)."""
+    merged: Dict[tuple, dict] = {}
+    for entry in list(a) + list(b):
+        k = tuple(entry.get(f) for f in key_fields)
+        cur = merged.get(k)
+        if cur is None:
+            merged[k] = {f: entry.get(f) for f in
+                         key_fields + sum_fields + keep_fields}
+            continue
+        for f in sum_fields:
+            cur[f] = (cur.get(f) or 0) + (entry.get(f) or 0)
+        for f in keep_fields:
+            x, y = cur.get(f), entry.get(f)
+            if y is not None and (x is None or y > x):
+                cur[f] = y
+    return [merged[k] for k in sorted(merged, key=lambda t: tuple(
+        str(x) for x in t))]
+
+
+def _fold_hist_samples(a: List[dict], b: List[dict]) -> List[dict]:
+    """Sum histogram samples label-wise (buckets carry cumulative
+    counts: the elementwise sum of two cumulative series is the
+    cumulative series of the sum)."""
+    merged: Dict[str, dict] = {}
+    for s in list(a) + list(b):
+        key = json.dumps(s.get("labels", {}), sort_keys=True)
+        cur = merged.get(key)
+        if cur is None:
+            merged[key] = json.loads(json.dumps(s))  # deep copy
+            continue
+        cur["count"] = cur.get("count", 0) + s.get("count", 0)
+        cur["sum"] = cur.get("sum", 0) + s.get("sum", 0)
+        cb, sb = cur.get("buckets"), s.get("buckets")
+        if isinstance(cb, list) and isinstance(sb, list) \
+                and [x[0] for x in cb] == [x[0] for x in sb]:
+            cur["buckets"] = [[x[0], x[1] + y[1]]
+                              for x, y in zip(cb, sb)]
+    return [merged[k] for k in sorted(merged)]
+
+
+def _latest(a: dict, b: dict, stamp: str) -> dict:
+    """Pick the later snapshot (max ``stamp``, JSON-string tie-break) —
+    a commutative, associative selection for point-in-time blocks."""
+    if not a:
+        return b
+    if not b:
+        return a
+    ka = (a.get(stamp) if a.get(stamp) is not None else -1,
+          json.dumps(a, sort_keys=True))
+    kb = (b.get(stamp) if b.get(stamp) is not None else -1,
+          json.dumps(b, sort_keys=True))
+    return a if ka >= kb else b
+
+
+def derive_profile(doc: dict) -> dict:
+    """Recompute every derived field (shares, means, ratios) from the
+    raw sums in place. Folds carry raw sums; callers see a ledger whose
+    derived fields are always consistent with them."""
+    total = sum(e.get("dur_us", 0.0) for e in doc["sites"])
+    for e in doc["sites"]:
+        e["share"] = (e["dur_us"] / total) if total else 0.0
+    doc["sites"].sort(key=lambda e: (-e["dur_us"], e["site"]))
+    for p in doc["programs"]:
+        n = p.get("captures", 0)
+        p["run_ms_mean"] = (p["run_ms_sum"] / n) if n else 0.0
+        mfu_n = p.get("mfu_samples", 0)
+        p["mfu_pct_mean"] = ((p["mfu_pct_sum"] / mfu_n)
+                             if mfu_n else None)
+        pred = p.get("predicted_ms")
+        p["measured_vs_predicted"] = (
+            round(p["run_ms_mean"] / pred, 4)
+            if pred and p["run_ms_mean"] else None)
+    for pool, ph in doc["phases"].items():
+        n = ph.get("captures", 0)
+        ph["run_ms_mean"] = (ph["run_ms_sum"] / n) if n else 0.0
+    ktotal = sum(k.get("ms", 0.0) for k in doc["kernels"])
+    for k in doc["kernels"]:
+        k["share"] = (k["ms"] / ktotal) if ktotal else 0.0
+    doc["kernels"].sort(key=lambda k: (-k["ms"], k["variant"]))
+    stotal = sum(s.get("measured_ms", 0.0)
+                 for s in doc["schedule_segments"])
+    for s in doc["schedule_segments"]:
+        s["share"] = (s["measured_ms"] / stotal) if stotal else 0.0
+    doc["schedule_segments"].sort(
+        key=lambda s: (-s["measured_ms"], s["site"]))
+    over = doc["overhead"]
+    over["overhead_pct"] = (
+        round(100.0 * over["capture_ms"] / over["base_wall_ms"], 3)
+        if over.get("base_wall_ms") else 0.0)
+    return doc
+
+
+def fold_profiles(a: Optional[dict], b: Optional[dict]) -> dict:
+    """Merge two WorkloadProfile ledgers. Commutative and associative
+    (pinned by tests/test_prodscope.py): sums for accumulated blocks,
+    later-snapshot-wins for point-in-time blocks, set-union for
+    conflicting tags. Sentinel EWMA state is deliberately NOT in the
+    ledger — it is order-dependent and lives in the scope instance."""
+    if not a:
+        return derive_profile(json.loads(json.dumps(b or
+                                                    empty_profile())))
+    if not b:
+        return derive_profile(json.loads(json.dumps(a)))
+    for doc in (a, b):
+        if doc.get("format") != PROFILE_FORMAT:
+            raise ValueError(f"fold_profiles: not a {PROFILE_FORMAT} "
+                             f"ledger (format={doc.get('format')!r})")
+    out = empty_profile(_fold_tags(a.get("tags", {}), b.get("tags", {})))
+    wa, wb = a["window"], b["window"]
+    firsts = [w["first_vnow_ms"] for w in (wa, wb)
+              if w.get("first_vnow_ms") is not None]
+    lasts = [w["last_vnow_ms"] for w in (wa, wb)
+             if w.get("last_vnow_ms") is not None]
+    out["window"] = {
+        "first_vnow_ms": min(firsts) if firsts else None,
+        "last_vnow_ms": max(lasts) if lasts else None,
+        "runs": wa.get("runs", 0) + wb.get("runs", 0)}
+    out["captures"] = {
+        k: a["captures"].get(k, 0) + b["captures"].get(k, 0)
+        for k in ("count", "dispatches_seen", "events_folded")}
+    out["sites"] = _sum_keyed(a["sites"], b["sites"], ("site",),
+                              ("dur_us", "slices"))
+    out["programs"] = _sum_keyed(
+        a["programs"], b["programs"], ("program", "pool", "bucket"),
+        ("captures", "run_ms_sum", "mfu_pct_sum", "mfu_samples"),
+        keep_fields=("flops", "predicted_ms"))
+    pools = set(a["phases"]) | set(b["phases"])
+    out["phases"] = {
+        pool: {k: (a["phases"].get(pool, {}).get(k, 0)
+                   + b["phases"].get(pool, {}).get(k, 0))
+               for k in ("captures", "run_ms_sum")}
+        for pool in sorted(pools)}
+    out["kernels"] = _sum_keyed(a["kernels"], b["kernels"],
+                                ("variant",), ("ms",))
+    out["schedule_segments"] = _sum_keyed(
+        a["schedule_segments"], b["schedule_segments"],
+        ("site", "reuse"), ("measured_ms",))
+    fams = set(a["stage_histograms"]) | set(b["stage_histograms"])
+    out["stage_histograms"] = {
+        fam: _fold_hist_samples(a["stage_histograms"].get(fam, []),
+                                b["stage_histograms"].get(fam, []))
+        for fam in sorted(fams)}
+    out["device_memory"] = _latest(a["device_memory"],
+                                   b["device_memory"], "sampled_at_ms")
+    out["drift"] = {
+        "events": a["drift"].get("events", 0) + b["drift"].get(
+            "events", 0),
+        "by_kind": {k: (a["drift"].get("by_kind", {}).get(k, 0)
+                        + b["drift"].get("by_kind", {}).get(k, 0))
+                    for k in sorted(set(a["drift"].get("by_kind", {}))
+                                    | set(b["drift"].get("by_kind",
+                                                         {})))}}
+    last = _latest(a["drift"].get("last", {}), b["drift"].get(
+        "last", {}), "vnow_ms")
+    if last:
+        out["drift"]["last"] = last
+    out["overhead"] = {
+        k: a["overhead"].get(k, 0.0) + b["overhead"].get(k, 0.0)
+        for k in ("capture_ms", "base_wall_ms")}
+    out["overhead"]["overhead_pct"] = 0.0
+    return derive_profile(out)
+
+
+def _schedule_reuse(schedule: Optional[dict], site: str) -> float:
+    """The committed schedule's implied reuse fraction for ``site``.
+
+    Schedule-spec table values (the tools/schedules artifact shape:
+    per-family tables with a ``"*"`` default, falling back to
+    ``cfg_gate``) are FLIP points — the fraction of the run at which the
+    site switches to cached reuse — so the reused share of steps is
+    ``1 - flip``. A fractional flip converts exactly; ``"auto"``
+    approximates as the half-run gate it resolves to; absolute-step and
+    ``null`` specs contribute 0 (no steps attributable to "use" without
+    the run's step count). 0.0 without a schedule: every step runs the
+    compute variant."""
+    if not isinstance(schedule, dict):
+        return 0.0
+    family = "cross" if site.startswith("cross_attn/") else "self"
+    table = schedule.get(family)
+    if not isinstance(table, dict):
+        table = {}
+    flip = table.get(site, table.get("*", schedule.get("cfg_gate")))
+    if flip == "auto":
+        return 0.5
+    if isinstance(flip, float) and 0.0 <= flip <= 1.0:
+        return 1.0 - flip
+    return 0.0
+
+
+class ProdScope:
+    """The serve engine's production-profiling sidecar (see the module
+    docstring). One scope covers one ``serve_forever`` run; pointing a
+    new run at the same directory folds the new session into the
+    on-disk ledger (restart-mergeable, like the journal)."""
+
+    def __init__(self, out_dir: str, *, seed: int = 0, period: int = 8,
+                 ring_max_bytes: int = 256 << 20, ring_max_count: int = 16,
+                 tags: Optional[dict] = None, registry=None,
+                 devices: int = 1, ewma_alpha: float = 0.3,
+                 drift_threshold: float = 0.25,
+                 drift_min_samples: int = 3):
+        self.out_dir = out_dir
+        os.makedirs(out_dir, exist_ok=True)
+        self.ledger_path = os.path.join(out_dir, LEDGER_NAME)
+        self.ring = TraceRing(os.path.join(out_dir, "ring"),
+                              max_bytes=ring_max_bytes,
+                              max_count=ring_max_count)
+        self.orphans_swept = self.ring.sweep_orphans()
+        self.plan = SamplingPlan(seed=seed, period=period)
+        self.devices = max(1, int(devices))
+        self.tags = dict(tags or {})
+        self._registry = registry or metrics_mod.registry()
+        # Restart continuity: the previous incarnation's ledger becomes
+        # the fold base; a corrupt/foreign file starts fresh (and is
+        # overwritten at the first persist — the orphan-GC discipline).
+        self._base: Optional[dict] = None
+        if os.path.exists(self.ledger_path):
+            try:
+                self._base = traceparse.load_workload_profile(
+                    self.ledger_path)
+            except (ValueError, OSError):
+                self._base = None
+        self._session = empty_profile(self.tags)
+        self._session["window"]["runs"] = 1
+        self._cards: Dict[tuple, dict] = {}
+        self._peaks = None
+        self._ordinals: Dict[str, int] = {}
+        self._seq = self.ring.next_seq()
+        self._active: Optional[dict] = None
+        self._pending: List[dict] = []
+        self._capture_ms = 0.0
+        self._base_wall_ms = 0.0
+        self._gc_evicted = 0
+        self._sentinels = {
+            kind: DriftSentinel(kind, alpha=ewma_alpha,
+                                threshold=drift_threshold,
+                                min_samples=drift_min_samples)
+            for kind in ("predicted_ratio", "site_share", "mfu")}
+        # Families register only under an active scope — a profile-less
+        # serve run's registry snapshot stays byte-identical (the
+        # disabled-mode discipline shared with CostScope).
+        reg = self._registry
+        self._m_captures = reg.counter(
+            "serve_profile_captures_total",
+            "sampled device-trace captures folded into the ledger")
+        self._m_sampled = reg.counter(
+            "serve_profile_sampled_dispatches_total",
+            "dispatches selected by the sampling plan")
+        self._m_drift = reg.gauge(
+            "serve_profile_drift",
+            "latest relative EWMA deviation per drift-sentinel kind",
+            labels=("kind",))
+        self._m_drift_events = reg.counter(
+            "serve_profile_drift_events_total",
+            "journaled profile_drift events", labels=("kind",))
+        self._m_ring_bytes = reg.gauge(
+            "serve_profile_ring_bytes", "trace-ring bytes on disk")
+        self._m_ring_count = reg.gauge(
+            "serve_profile_ring_captures",
+            "trace-ring committed captures on disk")
+
+    # -- build-time ------------------------------------------------------
+
+    def _get_peaks(self):
+        if self._peaks is None:
+            from . import costmodel
+            self._peaks = costmodel.detect_peaks()
+        return self._peaks
+
+    def record_program(self, key, bucket: int, compiled) -> None:
+        """Index one compiled program at build time: the HLO-text
+        op→site index (the trace join key) plus the minimal cost-card
+        facts (flops, predicted ms) the drift sentinels compare measured
+        dispatches against."""
+        from . import costmodel
+
+        label = costmodel._program_label(key, bucket)
+        entry = {"label": label, "op_index": {}, "flops": 0.0,
+                 "predicted_ms": None}
+        try:
+            text = compiled.as_text()
+        except Exception:
+            text = ""
+        if text:
+            entry["op_index"] = traceparse.op_site_index(text)
+        try:
+            card = costmodel.card_from_compiled(compiled, label)
+            if card.flops > 0 or card.bytes_accessed > 0:
+                roof = costmodel.roofline(card.flops,
+                                          card.bytes_accessed,
+                                          self._get_peaks(),
+                                          devices=self.devices)
+                entry["flops"] = card.flops
+                entry["predicted_ms"] = roof["predicted_ms"]
+        except Exception:
+            pass  # a card-less program still profiles (sites only)
+        self._cards[(key, bucket)] = entry
+
+    # -- dispatch-time ---------------------------------------------------
+
+    def begin(self, pool: str, key, bucket: int, lanes: int) -> dict:
+        """Bracket-open for one dispatch. Counts the pool ordinal
+        against the sampling plan; a sampled dispatch (at most one
+        capture in flight — jax profiler sessions don't nest) starts a
+        programmatic trace into a ring tmp dir. Always returns a handle
+        for :meth:`stop`/:meth:`abort`."""
+        ordinal = self._ordinals[pool] = self._ordinals.get(pool, 0) + 1
+        self._session["captures"]["dispatches_seen"] += 1
+        handle = {"pool": pool, "key": key, "bucket": bucket,
+                  "lanes": lanes, "ordinal": ordinal, "sampled": False,
+                  "t0": time.perf_counter()}
+        if self._active is None and self.plan.sampled(pool, ordinal):
+            seq = self._seq
+            self._seq += 1
+            tmp = self.ring.tmp_dir(seq)
+            t0 = time.perf_counter()
+            try:
+                import jax
+
+                jax.profiler.start_trace(tmp)
+            except Exception:
+                shutil.rmtree(tmp, ignore_errors=True)
+                handle["t0"] = time.perf_counter()
+                return handle
+            self._capture_ms += (time.perf_counter() - t0) * 1e3
+            handle.update(sampled=True, seq=seq, tmp=tmp)
+            self._active = handle
+            self._m_sampled.inc()
+            handle["t0"] = time.perf_counter()
+        return handle
+
+    def stop(self, handle: dict, run_ms: float, vnow: float) -> None:
+        """Bracket-close after a successful run: the profiler stops (tmp
+        trace files are durable on disk from here — the crash window the
+        ``kill_during_capture`` chaos drill aims at) and the capture
+        queues for :meth:`finalize` at the batch-boundary sync."""
+        self._base_wall_ms += (time.perf_counter() - handle["t0"]) * 1e3
+        if not handle["sampled"]:
+            return
+        t0 = time.perf_counter()
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            shutil.rmtree(handle["tmp"], ignore_errors=True)
+            self._active = None
+            return
+        self._capture_ms += (time.perf_counter() - t0) * 1e3
+        handle["run_ms"] = float(run_ms)
+        handle["vnow_ms"] = round(float(vnow), 3)
+        self._pending.append(handle)
+        self._active = None
+
+    def abort(self, handle: dict) -> None:
+        """Bracket-close for a dispatch that raised: the profiler stops
+        and the tmp capture is discarded (a faulted run's trace would
+        poison the ledger with fault-path timings)."""
+        self._base_wall_ms += (time.perf_counter() - handle["t0"]) * 1e3
+        if not handle["sampled"]:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        shutil.rmtree(handle["tmp"], ignore_errors=True)
+        self._active = None
+
+    def pending(self) -> bool:
+        return bool(self._pending)
+
+    # -- batch-boundary fold ---------------------------------------------
+
+    def finalize(self, kill_hook=None) -> dict:
+        """Fold every stopped capture: parse its trace through the
+        op→site index, tag + atomically commit the artifact into the
+        ring, GC past the caps, update the session ledger + drift
+        sentinels, persist the merged ledger. ``kill_hook`` (the chaos
+        ``kill_during_capture`` window) runs after the tmp trace is
+        durable and before the commit rename — dying there leaves
+        exactly the orphan the startup sweep must collect. Returns
+        ``{"captures": n, "drift_events": [...]}``."""
+        if not self._pending:
+            return {"captures": 0, "drift_events": []}
+        pending, self._pending = self._pending, []
+        drift_events: List[dict] = []
+        n_folded = 0
+        t0 = time.perf_counter()
+        for h in pending:
+            if kill_hook is not None:
+                kill_hook()
+            card = self._cards.get((h["key"], h["bucket"]))
+            entries: List[dict] = []
+            events_n = 0
+            for tf in sorted(glob_mod.glob(
+                    os.path.join(h["tmp"], "**", "*.trace.json.gz"),
+                    recursive=True)):
+                try:
+                    evs = traceparse.load_trace_events(tf)
+                except (ValueError, OSError):
+                    continue
+                events_n += len(evs)
+                entries = _sum_keyed(
+                    entries,
+                    traceparse.fold_site_events(
+                        evs, card["op_index"] if card else None),
+                    ("site",), ("dur_us", "slices"))
+            mem = self._device_memory()
+            meta = {"seq": h["seq"], "pool": h["pool"],
+                    "program": card["label"] if card else None,
+                    "bucket": h["bucket"], "lanes": h["lanes"],
+                    "ordinal": h["ordinal"], "run_ms": h["run_ms"],
+                    "vnow_ms": h["vnow_ms"], "events": events_n,
+                    "sampling": self.plan.describe(),
+                    "tags": self.tags,
+                    "sites": entries, "device_memory": mem}
+            with open(os.path.join(h["tmp"], "meta.json"), "w") as f:
+                json.dump(meta, f, indent=1)
+                f.write("\n")
+            self.ring.commit(h["tmp"], h["seq"])
+            evicted, _ = self.ring.gc()
+            self._gc_evicted += evicted
+            self._fold_capture(h, entries, card, mem, events_n)
+            drift_events += self._observe_drift(h, entries, card)
+            n_folded += 1
+            self._m_captures.inc()
+        self._capture_ms += (time.perf_counter() - t0) * 1e3
+        for ev in drift_events:
+            kind = ev["drift"]
+            by = self._session["drift"]["by_kind"]
+            by[kind] = by.get(kind, 0) + 1
+            self._session["drift"]["events"] += 1
+            self._session["drift"]["last"] = ev
+            self._m_drift_events.labels(kind=kind).inc()
+        for kind, s in self._sentinels.items():
+            self._m_drift.labels(kind=kind).set(
+                round(s.last_deviation, 4))
+        self.write_ledger()
+        stats = self.ring.stats()
+        self._m_ring_bytes.set(stats["bytes"])
+        self._m_ring_count.set(stats["count"])
+        return {"captures": n_folded, "drift_events": drift_events}
+
+    def _device_memory(self) -> dict:
+        """Satellite fix (ISSUE 18): the live ``device_memory_bytes``
+        gauges, snapshotted at the capture point so trace artifacts and
+        memory headroom line up post-hoc."""
+        try:
+            from . import device as obs_device
+
+            return obs_device.sample_device_memory(self._registry)
+        except Exception:
+            return {}
+
+    def _fold_capture(self, h: dict, entries: List[dict],
+                      card: Optional[dict], mem: dict,
+                      events_n: int) -> None:
+        s = self._session
+        s["captures"]["count"] += 1
+        s["captures"]["events_folded"] += events_n
+        w = s["window"]
+        if w["first_vnow_ms"] is None or h["vnow_ms"] < w["first_vnow_ms"]:
+            w["first_vnow_ms"] = h["vnow_ms"]
+        if w["last_vnow_ms"] is None or h["vnow_ms"] > w["last_vnow_ms"]:
+            w["last_vnow_ms"] = h["vnow_ms"]
+        s["sites"] = _sum_keyed(s["sites"], entries, ("site",),
+                                ("dur_us", "slices"))
+        prog = {"program": card["label"] if card else
+                f"uncarded@b{h['bucket']}",
+                "pool": h["pool"], "bucket": h["bucket"], "captures": 1,
+                "run_ms_sum": h["run_ms"], "mfu_pct_sum": 0.0,
+                "mfu_samples": 0,
+                "flops": card["flops"] if card else 0.0,
+                "predicted_ms": card["predicted_ms"] if card else None}
+        if card and card["flops"] > 0 and h["run_ms"] > 0:
+            from . import costmodel
+
+            mfu = costmodel.mfu_pct(card["flops"], h["run_ms"],
+                                    self._get_peaks(),
+                                    devices=self.devices)
+            if mfu is not None:
+                prog["mfu_pct_sum"] = mfu
+                prog["mfu_samples"] = 1
+        s["programs"] = _sum_keyed(
+            s["programs"], [prog], ("program", "pool", "bucket"),
+            ("captures", "run_ms_sum", "mfu_pct_sum", "mfu_samples"),
+            keep_fields=("flops", "predicted_ms"))
+        pool = s["phases"].setdefault(h["pool"],
+                                      {"captures": 0, "run_ms_sum": 0.0})
+        pool["captures"] += 1
+        pool["run_ms_sum"] += h["run_ms"]
+        schedule = self.tags.get("schedule")
+        kernel_sites = self.tags.get("kernel_sites")
+        kernels: List[dict] = []
+        segments: List[dict] = []
+        for e in entries:
+            site = e["site"]
+            ms = e["dur_us"] / 1e3
+            reuse = _schedule_reuse(schedule, site)
+            if isinstance(schedule, dict):
+                segments.append({"site": site, "reuse": round(reuse, 4),
+                                 "measured_ms": ms})
+            # Variant attribution: the schedule's reuse fraction of the
+            # run executes the cached "use" path; the rest runs the
+            # site's compute variant (fused-edit when the kernel config
+            # covers it, materialized otherwise — the dispatch.py
+            # taxonomy).
+            base = ("fused-edit" if kernel_sites == "*"
+                    or (isinstance(kernel_sites, (list, tuple))
+                        and site in kernel_sites) else "materialized")
+            if reuse > 0:
+                kernels.append({"variant": "use", "ms": ms * reuse})
+            kernels.append({"variant": base, "ms": ms * (1.0 - reuse)})
+        s["kernels"] = _sum_keyed(s["kernels"], kernels, ("variant",),
+                                  ("ms",))
+        s["schedule_segments"] = _sum_keyed(
+            s["schedule_segments"], segments, ("site", "reuse"),
+            ("measured_ms",))
+        snap = self._registry.snapshot()
+        s["stage_histograms"] = {
+            fam: snap[fam]["samples"] for fam in STAGE_FAMILIES
+            if fam in snap}
+        if mem:
+            s["device_memory"] = {"sampled_at_ms": h["vnow_ms"],
+                                  "seq": h["seq"], "devices": mem}
+
+    def _observe_drift(self, h: dict, entries: List[dict],
+                       card: Optional[dict]) -> List[dict]:
+        events: List[dict] = []
+
+        def emit(ev):
+            if ev is not None:
+                ev["pool"] = h["pool"]
+                ev["vnow_ms"] = h["vnow_ms"]
+                events.append(ev)
+
+        if card and card["predicted_ms"]:
+            emit(self._sentinels["predicted_ratio"].observe(
+                card["label"], h["run_ms"] / card["predicted_ms"]))
+            if card["flops"] > 0 and h["run_ms"] > 0:
+                from . import costmodel
+
+                mfu = costmodel.mfu_pct(card["flops"], h["run_ms"],
+                                        self._get_peaks(),
+                                        devices=self.devices)
+                if mfu is not None:
+                    emit(self._sentinels["mfu"].observe(card["label"],
+                                                        mfu))
+        total = sum(e.get("dur_us", 0.0) for e in entries)
+        for e in entries:
+            emit(self._sentinels["site_share"].observe(
+                e["site"], (e["dur_us"] / total) if total else 0.0))
+        return events
+
+    # -- artifacts -------------------------------------------------------
+
+    def ledger(self) -> dict:
+        """The merged (base ⊕ session) WorkloadProfile."""
+        session = json.loads(json.dumps(self._session))
+        over = session["overhead"]
+        over["capture_ms"] = round(self._capture_ms, 3)
+        over["base_wall_ms"] = round(self._base_wall_ms, 3)
+        return fold_profiles(self._base, session)
+
+    def write_ledger(self) -> str:
+        """Persist the merged ledger atomically (tmp + rename)."""
+        doc = self.ledger()
+        tmp = self.ledger_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, self.ledger_path)
+        return self.ledger_path
+
+    def ledger_bytes(self) -> int:
+        try:
+            return os.path.getsize(self.ledger_path)
+        except OSError:
+            return 0
+
+    def blackbox_snapshot(self) -> dict:
+        """What the flight recorder ships with a FATAL bundle: the
+        active sampling plan and the latest merged ledger (the
+        performance context that preceded the impact)."""
+        return {"sampling_plan": self.plan.describe(),
+                "ring": self.ring.stats(),
+                "workload_profile": self.ledger()}
+
+    def summary(self) -> dict:
+        """The serve summary's ``profile`` block."""
+        doc = self.ledger()
+        return {
+            "captures": doc["captures"]["count"],
+            "dispatches_seen":
+                self._session["captures"]["dispatches_seen"],
+            "sampling": self.plan.describe(),
+            "ring": self.ring.stats(),
+            "ring_evicted": self._gc_evicted,
+            "orphans_swept": self.orphans_swept,
+            "ledger_path": self.ledger_path,
+            "ledger_bytes": self.ledger_bytes(),
+            "sites_measured": len(doc["sites"]),
+            "drift_events": self._session["drift"]["events"],
+            "overhead_pct": doc["overhead"]["overhead_pct"],
+        }

@@ -1,0 +1,292 @@
+"""traceparse — shared chrome-trace / workload-profile parsing (ISSUE 18).
+
+The ``perfscope --sites`` named_scope parser, factored out of the CLI so
+the serve engine's production profiler (:mod:`p2p_tpu.obs.prodscope`) and
+the tools (``perfscope``, ``schedule_search``) fold traces through one
+code path. Three layers:
+
+- **Chrome-trace loading** (:func:`load_trace_events`,
+  :func:`parse_site_trace`): gz-aware ``traceEvents`` extraction and the
+  PR-15 per-attention-site duration fold, behavior-identical to the old
+  ``tools/perfscope.py`` implementation.
+- **HLO op→site indexing** (:func:`op_site_index`,
+  :func:`fold_site_events`): on CPU (and on device backends that emit
+  bare HLO op names) trace events carry ``args.hlo_op`` — not the
+  ``named_scope`` path. But the *compiled HLO text* keeps the full scope
+  path in per-instruction ``metadata={op_name="..."}``. Indexing
+  instruction names to sites at program-build time (fusions attributed to
+  the dominant site of their called computation) lets the event fold
+  recover genuinely measured per-site durations from traces whose event
+  names alone carry no site information.
+- **WorkloadProfile format** (:data:`PROFILE_FORMAT`,
+  :func:`is_workload_profile`, :func:`load_workload_profile`,
+  :func:`profile_sites`, :func:`validate_profile`): the durable ledger
+  the profiler writes and ``schedule_search --profile`` /
+  ``perfscope --sites`` consume. Format confusion (a ledger where a
+  trace was expected, or vice versa) is a loud ``ValueError`` naming
+  both formats — never a silent empty table.
+
+Stdlib-only on purpose: tools import it without pulling jax.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import re
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
+
+#: Format sentinel every WorkloadProfile ledger carries under ``format``.
+PROFILE_FORMAT = "p2p-workload-profile/v1"
+
+#: An attention site name as it appears inside named_scope paths and HLO
+#: op metadata: ``cross_attn/down3``, ``self_attn/mid0``, ...
+SITE_RE = re.compile(r"(cross_attn|self_attn)/(?:down|mid|up)\d+")
+
+# HLO-text structure: a computation header opens a ``{`` block, each
+# instruction line is ``%name = ... metadata={op_name="scope/path" ...}``,
+# and fusion instructions name their called computation via ``calls=``.
+_COMP_RE = re.compile(
+    r"^(?:ENTRY\s+)?%?([A-Za-z0-9_.\-]+)\s*(?:\([^)]*\))?\s*->.*\{\s*$")
+_INSTR_RE = re.compile(
+    r'^\s*(?:ROOT\s+)?%([A-Za-z0-9_.\-]+)\s*=\s.*'
+    r'metadata=\{[^}]*op_name="([^"]+)"')
+_FUSION_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%([A-Za-z0-9_.\-]+)\s*=\s.*fusion\(.*"
+    r"calls=%?([A-Za-z0-9_.\-]+)")
+
+#: Top-level keys a v1 ledger must carry (schema table in
+#: docs/OBSERVABILITY.md mirrors this).
+PROFILE_REQUIRED_KEYS = (
+    "format", "version", "tags", "window", "captures", "sites",
+    "programs", "phases", "kernels", "schedule_segments",
+    "stage_histograms", "device_memory", "drift", "overhead",
+)
+
+
+def _load_json(path: str):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+def load_trace_events(path: str) -> list:
+    """Chrome-trace events from ``path`` (``traceEvents`` object or bare
+    event list, ``.gz``-compressed or not). Loud on format confusion:
+    handing it a WorkloadProfile ledger is a ``ValueError`` naming the
+    right flag, never an empty fold."""
+    data = _load_json(path)
+    if isinstance(data, dict) and is_workload_profile(data):
+        raise ValueError(
+            f"{path}: this is a WorkloadProfile ledger "
+            f"({PROFILE_FORMAT}), not a chrome trace — pass it where a "
+            "profile is accepted (perfscope --sites auto-detects it; "
+            "schedule_search takes --profile)")
+    events = data.get("traceEvents", data) if isinstance(data, dict) \
+        else data
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a chrome-trace (no traceEvents "
+                         "list)")
+    return events
+
+
+def fold_site_events(events: list, op_index: Optional[Dict[str, str]]
+                     = None) -> list:
+    """Sum per-site durations over chrome-trace ``events``.
+
+    Sites are resolved from the event name via :data:`SITE_RE`
+    (named_scope-instrumented device traces), falling back to
+    ``op_index`` — an ``{hlo instruction name: site}`` map built by
+    :func:`op_site_index` — keyed by ``args.hlo_op`` (or the bare event
+    name) for backends whose trace events carry only HLO op names.
+    Returns ``[{"site", "dur_us", "slices", "share"}]`` sorted hottest
+    first; empty when nothing matched (callers decide how loud that is).
+    """
+    durs: Dict[str, float] = {}
+    counts: Dict[str, int] = {}
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        name = e.get("name")
+        dur = e.get("dur")
+        if not name or dur is None:
+            continue
+        site = None
+        m = SITE_RE.search(str(name))
+        if m:
+            site = m.group(0)
+        elif op_index:
+            args = e.get("args") or {}
+            op = args.get("hlo_op") or name
+            site = op_index.get(str(op))
+        if site is None:
+            continue
+        durs[site] = durs.get(site, 0.0) + float(dur)
+        counts[site] = counts.get(site, 0) + 1
+    total = sum(durs.values())
+    return [{"site": s, "dur_us": durs[s], "slices": counts[s],
+             "share": (durs[s] / total) if total else 0.0}
+            for s in sorted(durs, key=lambda s: -durs[s])]
+
+
+def parse_site_trace(path: str, op_index: Optional[Dict[str, str]]
+                     = None) -> list:
+    """Aggregate per-attention-site device time from a Perfetto/Chrome
+    trace (ISSUE 15, the schedule search's seed input).
+
+    Every attention site is wrapped in a ``jax.named_scope`` whose name
+    (``cross_attn/down3``) lands in the HLO op metadata, so device slices
+    in a ``jax.profiler`` / ``serve --trace-out`` export carry the site
+    name inside the op name; ``op_index`` (see :func:`op_site_index`)
+    additionally recovers sites on backends whose events carry only bare
+    HLO op names. Durations are summed per site, shares normalized over
+    all matched sites. Raises ``ValueError`` when no site slice matched
+    — and, loudly, when handed a WorkloadProfile ledger instead of a
+    trace."""
+    entries = fold_site_events(load_trace_events(path), op_index)
+    if not entries:
+        raise ValueError(
+            f"{path}: no attention-site slices found — is this a DEVICE "
+            "trace of a named_scope-instrumented program? (site names "
+            "look like 'cross_attn/down3')")
+    return entries
+
+
+def op_site_index(hlo_text: str) -> Dict[str, str]:
+    """``{HLO instruction name: attention site}`` from compiled HLO text.
+
+    Instructions whose ``metadata.op_name`` scope path contains a site
+    name map directly; fusion instructions (whose own metadata names only
+    one member op) are attributed to the *dominant* site of their called
+    computation — the site owning the most member instructions. This is
+    the join key that makes CPU traces (bare ``dot.596`` event names,
+    ``args.hlo_op``) yield measured per-site shares."""
+    instr_site: Dict[str, str] = {}
+    comp_sites: Dict[str, Counter] = {}
+    fusions: List[Tuple[str, str]] = []
+    current = None
+    for line in hlo_text.splitlines():
+        cm = _COMP_RE.match(line)
+        if cm:
+            current = cm.group(1)
+            continue
+        im = _INSTR_RE.match(line)
+        if im:
+            sm = SITE_RE.search(im.group(2))
+            if sm:
+                instr_site[im.group(1)] = sm.group(0)
+                if current is not None:
+                    comp_sites.setdefault(
+                        current, Counter())[sm.group(0)] += 1
+        fm = _FUSION_RE.match(line)
+        if fm:
+            fusions.append((fm.group(1), fm.group(2)))
+    for instr, comp in fusions:
+        if instr in instr_site:
+            continue
+        ctr = comp_sites.get(comp)
+        if ctr:
+            instr_site[instr] = ctr.most_common(1)[0][0]
+    return instr_site
+
+
+# -- WorkloadProfile format ----------------------------------------------
+
+
+def is_workload_profile(doc) -> bool:
+    return (isinstance(doc, dict)
+            and doc.get("format") == PROFILE_FORMAT)
+
+
+def load_workload_profile(path: str) -> dict:
+    """A WorkloadProfile ledger from ``path``, loud on confusion: a
+    chrome trace (or anything else) raises ``ValueError`` naming what was
+    found and what was expected."""
+    doc = _load_json(path)
+    if isinstance(doc, dict) and not is_workload_profile(doc) \
+            and isinstance(doc.get("traceEvents"), list):
+        raise ValueError(
+            f"{path}: this is a chrome trace, not a WorkloadProfile "
+            f"ledger ({PROFILE_FORMAT}) — pass it where a trace is "
+            "accepted (perfscope --sites TRACE, or fold it with "
+            "serve --profile first)")
+    if not is_workload_profile(doc):
+        raise ValueError(
+            f"{path}: not a WorkloadProfile ledger — expected a JSON "
+            f"object with format={PROFILE_FORMAT!r}, got "
+            f"{type(doc).__name__} with format="
+            f"{doc.get('format')!r}" if isinstance(doc, dict) else
+            f"{path}: not a WorkloadProfile ledger — expected a JSON "
+            f"object with format={PROFILE_FORMAT!r}")
+    return doc
+
+
+def profile_sites(doc: dict) -> list:
+    """The ledger's per-site table in the exact ``--sites-json`` /
+    ``parse_site_trace`` entry shape. Loud when the ledger carries no
+    measured sites (a profile captured before any dispatch folded)."""
+    sites = doc.get("sites")
+    if not isinstance(sites, list) or not sites:
+        raise ValueError(
+            "workload profile carries no measured sites — was any "
+            "dispatch sampled? (captures: "
+            f"{(doc.get('captures') or {}).get('count', 0)})")
+    bad = [e for e in sites
+           if not isinstance(e, dict) or "site" not in e
+           or "share" not in e]
+    if bad:
+        raise ValueError(f"workload profile sites entries malformed: "
+                         f"{bad[:2]!r}")
+    return sites
+
+
+def validate_profile(doc: dict) -> List[str]:
+    """Schema problems in a ledger, empty when valid (the quality-gate
+    ``profile_parity`` leg's validation unit)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"not an object: {type(doc).__name__}"]
+    if doc.get("format") != PROFILE_FORMAT:
+        problems.append(f"format is {doc.get('format')!r}, "
+                        f"expected {PROFILE_FORMAT!r}")
+    for key in PROFILE_REQUIRED_KEYS:
+        if key not in doc:
+            problems.append(f"missing key {key!r}")
+    sites = doc.get("sites")
+    if isinstance(sites, list):
+        for e in sites:
+            if not isinstance(e, dict) or not {"site", "dur_us",
+                                               "slices", "share"} <= set(e):
+                problems.append(f"malformed sites entry: {e!r}")
+                break
+        total = sum(float(e.get("share", 0.0)) for e in sites
+                    if isinstance(e, dict))
+        if sites and not (0.999 <= total <= 1.001):
+            problems.append(f"site shares sum to {total:.4f}, not 1")
+    elif "sites" in doc:
+        problems.append("sites is not a list")
+    progs = doc.get("programs")
+    if isinstance(progs, list):
+        for p in progs:
+            if not isinstance(p, dict) or "program" not in p:
+                problems.append(f"malformed programs entry: {p!r}")
+                break
+    over = doc.get("overhead")
+    if isinstance(over, dict):
+        pct = over.get("overhead_pct")
+        if pct is not None and (not isinstance(pct, (int, float))
+                                or pct < 0):
+            problems.append(f"overhead_pct invalid: {pct!r}")
+    return problems
+
+
+def parse_sites_any(path: str) -> Tuple[list, str]:
+    """Site entries from either a chrome trace or a WorkloadProfile
+    ledger — sniffed by content, with each format's loud errors intact.
+    Returns ``(entries, kind)`` with kind ``"trace"`` or ``"profile"``.
+    """
+    doc = _load_json(path)
+    if is_workload_profile(doc):
+        return profile_sites(doc), "profile"
+    return parse_site_trace(path), "trace"

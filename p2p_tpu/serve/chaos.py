@@ -76,8 +76,15 @@ PREEMPT_THEN_KILL = "preempt_then_kill"
 #: journaled insert and serve the (still-pending) leader and followers
 #: from it: exactly-once, bitwise-identical to the uncached run.
 KILL_AFTER_CACHE_INSERT = "kill_after_cache_insert"
+#: ISSUE 18: die inside the profiler's batch-boundary finalize — after a
+#: sampled capture's trace files are durable in the ring's tmp dir but
+#: before the atomic commit rename. The restart must sweep the orphaned
+#: ``tmp-cap-*`` dir (the carry-spill GC discipline) and keep serving
+#: exactly-once; the ledger merely loses that one capture.
+KILL_DURING_CAPTURE = "kill_during_capture"
 LIFECYCLE_KINDS = (SIGTERM, KILL_DURING_DRAIN, KILL_DURING_SNAPSHOT,
-                   PREEMPT_THEN_KILL, KILL_AFTER_CACHE_INSERT)
+                   PREEMPT_THEN_KILL, KILL_AFTER_CACHE_INSERT,
+                   KILL_DURING_CAPTURE)
 
 KINDS = ("transient", "poison", "fatal", "hang", "nan") + LIFECYCLE_KINDS
 
@@ -140,7 +147,8 @@ class FaultPlan:
         drain-mode dispatch / the next snapshot's durable moment / the
         batch-boundary sync after a forced preemption)."""
         if kind not in (KILL_DURING_DRAIN, KILL_DURING_SNAPSHOT,
-                        PREEMPT_THEN_KILL, KILL_AFTER_CACHE_INSERT):
+                        PREEMPT_THEN_KILL, KILL_AFTER_CACHE_INSERT,
+                        KILL_DURING_CAPTURE):
             raise ValueError(f"not a kill kind: {kind!r}")
         self._armed_kills.add(kind)
 

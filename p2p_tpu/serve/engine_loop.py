@@ -331,6 +331,7 @@ def serve_forever(
     slo=None,
     semcache=None,
     costscope=None,
+    prodscope=None,
 ) -> Iterator[dict]:
     """Drain ``requests`` (Request/Cancel objects or JSONL-shaped dicts,
     sorted by ``arrival_ms``) through the queue → batcher → program-cache →
@@ -448,6 +449,24 @@ def serve_forever(
     artifact, never in a request record or journal line.
     ``costscope=None`` (the default) changes nothing, same discipline
     as the other sidecars.
+
+    ``prodscope`` (None | ``obs.prodscope.ProdScope``) enables in-engine
+    sampled device profiling (ISSUE 18, docs/OBSERVABILITY.md
+    "Production profiling"): a deterministic seeded per-pool sampling
+    plan picks every Nth dispatch to run under a programmatic
+    ``jax.profiler`` capture into a bounded on-disk trace ring; at each
+    batch-boundary sync the stopped captures are folded (via the
+    compiled programs' HLO op→site index) into a durable mergeable
+    WorkloadProfile ledger — the seed artifact ``schedule_search
+    --profile`` and ``perfscope --sites`` consume — and EWMA drift
+    sentinels compare measured ms / site shares / MFU against their
+    running baselines, journaling ``profile_drift`` events and feeding
+    the ``serve_profile_drift`` gauges. The summary gains a ``profile``
+    block. Profile facts never enter a request record; drift events are
+    the ONLY journal addition, and only under an active scope.
+    ``prodscope=None`` (the default) changes nothing — records, journal
+    and compiled programs byte-identical (the quality gate's
+    ``profile_parity`` leg pins it).
     """
     from ..engine.sampler import lane_select
     from ..utils import progress as progress_mod
@@ -464,6 +483,8 @@ def serve_forever(
         # The scope scales peaks by the mesh width: a dp-sharded dispatch
         # runs its (global-batch) program across dp devices' peaks.
         costscope.devices = max(1, dp)
+    if prodscope is not None:
+        prodscope.devices = max(1, dp)
 
     def mkey(key):
         """Program-cache key for one dispatch: the mesh shape joins it so
@@ -802,7 +823,8 @@ def serve_forever(
         runner = factory(compile_key, bucket)
         warm = getattr(runner, "warm", None)
         lower = (getattr(runner, "cost_lowered", None)
-                 if costscope is not None else None)
+                 if (costscope is not None or prodscope is not None)
+                 else None)
         if lower is not None and jmesh is None:
             # Cost observatory: AOT-compile FIRST — the real XLA compile
             # is timed as compile_ms{what="build"} and populates the
@@ -825,9 +847,17 @@ def serve_forever(
             warm_ms = (time.perf_counter() - t1) * 1000.0
             obs_device.record_compile(warm_ms, what="warm")
             if compiled is not None:
-                costscope.record_program(compile_key, bucket, compiled,
-                                         build_ms=build_ms,
-                                         warm_ms=warm_ms)
+                if costscope is not None:
+                    costscope.record_program(compile_key, bucket,
+                                             compiled,
+                                             build_ms=build_ms,
+                                             warm_ms=warm_ms)
+                if prodscope is not None:
+                    # Production profiler: the compiled HLO text's
+                    # op→site index is the join key that turns this
+                    # program's sampled traces into per-site shares.
+                    prodscope.record_program(compile_key, bucket,
+                                             compiled)
         elif lower is not None:
             # Mesh serving: the card comes from the MESH-LESS logical
             # twin (cost_lowered lowers without shardings), which shares
@@ -846,7 +876,12 @@ def serve_forever(
             card_ms = (time.perf_counter() - t0) * 1000.0
             obs_device.record_compile(card_ms, what="cost_card")
             if compiled is not None:
-                costscope.record_program(compile_key, bucket, compiled)
+                if costscope is not None:
+                    costscope.record_program(compile_key, bucket,
+                                             compiled)
+                if prodscope is not None:
+                    prodscope.record_program(compile_key, bucket,
+                                             compiled)
         elif warm is not None:
             warm(entries)
         return runner
@@ -1075,6 +1110,43 @@ def serve_forever(
                 chaos.take_kill(chaos_mod.KILL_DURING_SNAPSHOT):
             raise chaos_mod.SimulatedKill("chaos kill_during_snapshot")
 
+    def _profile_extras():
+        """Blackbox sidecar (ISSUE 18): a FATAL/watchdog bundle ships
+        with the profiler's latest ledger and active sampling plan —
+        the performance context that preceded the impact. None when the
+        profiler is off, so bundles stay byte-identical without it."""
+        if prodscope is None:
+            return None
+        return {"workload_profile": prodscope.blackbox_snapshot()}
+
+    def _capture_kill_hook():
+        # chaos kill_during_capture: dies inside the profiler's finalize
+        # — a sampled capture's trace files durable in the ring's tmp dir
+        # but the atomic commit rename not yet done. Terminals sync first
+        # (matching the healthy loop's fsync point: the drill targets the
+        # ring's orphan window, not the journal tail); the restart must
+        # sweep the orphan and keep serving exactly-once.
+        if chaos is not None and \
+                chaos.take_kill(chaos_mod.KILL_DURING_CAPTURE):
+            if journal is not None:
+                journal.sync()
+            raise chaos_mod.SimulatedKill("chaos kill_during_capture")
+
+    def _profile_finalize():
+        """Fold the profiler's stopped captures at the batch-boundary
+        sync (drift events are journaled here, right before the fsync
+        point, so a ``profile_drift`` line is durable with its batch)."""
+        if prodscope is None or not prodscope.pending():
+            return
+        out = prodscope.finalize(kill_hook=_capture_kill_hook)
+        for ev in out["drift_events"]:
+            if journal is not None:
+                journal.event("profile_drift", **ev)
+            if flight is not None:
+                flight.loop_event("profile_drift", vnow,
+                                  kind=ev["drift"], key=ev["key"],
+                                  deviation=ev["deviation"])
+
     def take_snapshot(trigger: str) -> dict:
         """One journal.compact pass + its bookkeeping (periodic + drain)."""
         nonlocal snapshots_taken
@@ -1260,7 +1332,8 @@ def serve_forever(
                                   make_runner, k, b, [e]))
         prewarm_ms = (timer() - t0) * 1000.0
 
-    def run_entries(entries, compile_key, guidance, bucket, fault=None):
+    def run_entries(entries, compile_key, guidance, bucket, fault=None,
+                    pool="mono"):
         """Run one padded batch; returns (images, run_ms, hit, steps_done,
         finite). The steps the compiled loop reports flow into per-request
         progress via the shared step hook — and, when the watchdog is
@@ -1311,18 +1384,30 @@ def serve_forever(
                     raise faults_mod.InjectedFault(fault.kind, fault.target)
             return runner(entries, guidance)
 
+        # Production profiler bracket: a sampled dispatch runs under a
+        # programmatic jax.profiler capture. begin/stop/abort only — the
+        # trace FOLD happens at the batch-boundary sync, never here, so a
+        # profiler problem can never be classified as a dispatch fault.
+        cap = (prodscope.begin(pool, compile_key, bucket, len(entries))
+               if prodscope is not None else None)
         try:
             if watchdog_ms is not None:
                 imgs = faults_mod.run_with_watchdog(
                     call, watchdog_ms, heartbeat=lambda: beats[0])
             else:
                 imgs = call()
+        except BaseException:
+            if cap is not None:
+                prodscope.abort(cap)
+            raise
         finally:
             if progress:
                 progress_mod.set_step_hook(None)
             if watchdog_ms is not None:
                 progress_mod.set_watchdog_sink(None)
         run_ms = (timer() - t0) * 1000.0
+        if cap is not None:
+            prodscope.stop(cap, run_ms, vnow)
         if costscope is not None:
             # One measured-MFU observation per dispatch; the returned
             # attrs ride the flight run segment (predicted-vs-measured).
@@ -1347,7 +1432,8 @@ def serve_forever(
         reason = f"{type(exc).__name__}: {exc}"
         if kind == faults_mod.FATAL and flight is not None:
             flight.loop_event("fatal", vnow, reason=reason)
-            flight.blackbox("fatal_fault", _loop_state())
+            flight.blackbox("fatal_fault", _loop_state(),
+                            extras=_profile_extras())
         return kind, reason
 
     def _note_timeout(compile_key, bucket):
@@ -1363,7 +1449,8 @@ def serve_forever(
         cache.quarantine((compile_key, bucket))
         if flight is not None:
             flight.loop_event("watchdog_timeout", vnow)
-            flight.blackbox("watchdog_timeout", _loop_state())
+            flight.blackbox("watchdog_timeout", _loop_state(),
+                            extras=_profile_extras())
 
     def _live_after_backoff(entries):
         """Split entries into (records to yield, survivors) after vnow
@@ -1898,7 +1985,8 @@ def serve_forever(
                              occupancy=len(live), phase=1,
                              **({"attempt": attempt} if attempt else {})):
                     carry_g, run_ms, hit, _, _ = run_entries(
-                        live, compile_key, guidance, bucket, fault=fault)
+                        live, compile_key, guidance, bucket, fault=fault,
+                        pool="phase1")
                 total_ms = (timer() - t0) * 1000.0
                 compile_ms = max(0.0, total_ms - run_ms)
                 break
@@ -1999,7 +2087,8 @@ def serve_forever(
                         span("serve.isolate_retry", batch=batch_index,
                              lanes=bucket, request=e.request_id, phase=1):
                     carry_g, run_ms, hit, _, _ = run_entries(
-                        [e], compile_key, guidance, bucket, fault=fault)
+                        [e], compile_key, guidance, bucket, fault=fault,
+                        pool="phase1")
                 compile_ms = max(0.0, (timer() - t0) * 1000.0 - run_ms)
             except Exception as exc:  # noqa: BLE001 — classified below
                 elapsed = (timer() - t0) * 1000.0
@@ -2154,7 +2243,8 @@ def serve_forever(
                              occupancy=len(live), phase=2,
                              **({"attempt": attempt} if attempt else {})):
                     imgs, run_ms, hit, _, finite = run_entries(
-                        live, compile_key, guidance, bucket, fault=fault)
+                        live, compile_key, guidance, bucket, fault=fault,
+                        pool="phase2")
                 total_ms = (timer() - t0) * 1000.0
                 compile_ms = max(0.0, total_ms - run_ms)
                 break
@@ -2277,7 +2367,8 @@ def serve_forever(
                         span("serve.isolate_retry", batch=batch_index,
                              lanes=bucket, request=e.request_id, phase=2):
                     imgs, run_ms, hit, _, finite = run_entries(
-                        [e], compile_key, guidance, bucket, fault=fault)
+                        [e], compile_key, guidance, bucket, fault=fault,
+                        pool="phase2")
                 compile_ms = max(0.0, (timer() - t0) * 1000.0 - run_ms)
             except Exception as exc:  # noqa: BLE001 — classified below
                 elapsed = (timer() - t0) * 1000.0
@@ -2750,6 +2841,7 @@ def serve_forever(
                     journal.event("fatal", reason=fatal_reason[0],
                                   vnow_ms=round(vnow, 3))
                 break
+        _profile_finalize()
         if journal is not None:
             journal.sync()  # batch boundary: the fsync point
         if chaos is not None and \
@@ -2817,6 +2909,10 @@ def serve_forever(
             flight.loop_event("drained", vnow,
                               pending=drain_info["pending"])
 
+    # Final profiler flush: captures stopped by the last (or drain-mode)
+    # dispatches fold before the summary reads the ledger.
+    _profile_finalize()
+
     n_batches = len(occupancies)
     lat_sorted = sorted(latencies)
     summary = {
@@ -2883,6 +2979,9 @@ def serve_forever(
         # Present only under an active CostScope, so cost-less summaries
         # stay byte-identical (disabled-mode parity).
         summary["cost"] = costscope.summary()
+    if prodscope is not None:
+        # Present only under an active ProdScope, same parity discipline.
+        summary["profile"] = prodscope.summary()
     if sc is not None:
         # Present only under an active SemCache, so cache-less summaries
         # stay byte-identical (disabled-mode parity).

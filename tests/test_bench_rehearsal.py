@@ -152,6 +152,18 @@ SERVE_CACHE_KEYS = {
 }
 
 
+#: ISSUE 18: the serve block's `profile` sub-record — the rehearsal trace
+#: re-served with the production profiler sampling 1-in-4 dispatches.
+#: Frozen literal: overhead_pct is a benchwatch headline key (lower is
+#: better; scale-dependent, the trend is the signal), and captures /
+#: sites_measured / ledger_bytes record that the sampled-capture → ledger
+#: fold actually produced a consumable workload profile.
+SERVE_PROFILE_KEYS = {
+    "captures", "sampled_1_in", "sites_measured",
+    "ledger_bytes", "overhead_pct", "drift_events",
+}
+
+
 def test_rehearsal_schema_unchanged_by_static_analysis_pr():
     """ISSUE 5 was a static-analysis PR, ISSUE 6 a serve-architecture PR,
     ISSUE 10 a mesh-serving PR, ISSUE 12 an SLO-scheduling PR and
@@ -159,8 +171,9 @@ def test_rehearsal_schema_unchanged_by_static_analysis_pr():
     exactly the PR-4 set (ISSUE 6 grows the serve block's NESTED `phases`
     sub-record — SERVE_PHASES_KEYS — ISSUE 10 its NESTED `mesh`
     sub-record — SERVE_MESH_KEYS — ISSUE 12 its NESTED `slo` sub-record
-    — SERVE_SLO_KEYS — and ISSUE 13 its NESTED `cache` sub-record —
-    SERVE_CACHE_KEYS). A future PR that grows the schema updates the
+    — SERVE_SLO_KEYS — ISSUE 13 its NESTED `cache` sub-record —
+    SERVE_CACHE_KEYS — and ISSUE 18 its NESTED `profile` sub-record —
+    SERVE_PROFILE_KEYS). A future PR that grows the schema updates the
     frozen copies (and EXPECTED_KEYS, and bench._BLOCK_KEYS) in the same
     diff, deliberately."""
     assert EXPECTED_KEYS == {
@@ -708,6 +721,20 @@ def test_bench_rehearsal_green_and_complete():
     assert cb["l3_evictions"] >= 1
     assert cb["amplification"] > 1.0
     assert cb["uncached_makespan_ms"] > cb["cached_makespan_ms"]
+    # Production-profiling acceptance (ISSUE 18): the profiler leg
+    # actually sampled captures out of the rehearsal trace and folded
+    # them into a ledger with measured sites; the capture overhead is
+    # recorded honestly (large at CPU-rehearsal dispatch durations —
+    # the benchwatch trend on serve.profile.overhead_pct is the signal,
+    # never an absolute threshold here).
+    pb = doc["serve"]["profile"]
+    assert set(pb) == SERVE_PROFILE_KEYS
+    assert pb["captures"] >= 1
+    assert pb["sampled_1_in"] == 4
+    assert pb["sites_measured"] >= 1
+    assert pb["ledger_bytes"] > 0
+    assert pb["overhead_pct"] >= 0
+    assert pb["drift_events"] >= 0
     mb = doc["serve"]["mesh"]
     assert set(mb) == SERVE_MESH_KEYS
     assert mb["devices"] >= 2            # the virtual mesh really spanned

@@ -1,0 +1,525 @@
+"""Production profiling (ISSUE 18): sampling plans, the bounded trace
+ring, WorkloadProfile fold algebra, drift sentinels, and the chaos
+``kill_during_capture`` crash-restart drill.
+
+Fold discipline pinned here: ``fold_profiles`` must be commutative AND
+associative (restart merge order and multi-host ledger merges must not
+change the answer), the ring must never exceed either cap, and a crash
+between a capture's tmp write and its commit rename must leave exactly
+one orphan the next startup sweeps — the carry-spill GC discipline.
+Serve-engine legs run the FakeRunner/VirtualTimer control-flow idiom
+(test_serve); the real-runner byte-identical neutrality contract lives
+in tools/quality_gate.py's ``profile_parity`` leg.
+"""
+
+import glob
+import json
+import os
+import warnings
+
+import pytest
+
+from p2p_tpu.obs import prodscope as ps
+from p2p_tpu.obs import traceparse
+from p2p_tpu.serve import Journal, Request, SimulatedKill, serve_forever
+from p2p_tpu.serve.chaos import FaultPlan
+from tests.test_serve import FakeRunner, VirtualTimer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _req(rid, arrival=0.0, steps=4, **kw):
+    return Request(request_id=rid, prompt="a cat", target="a dog",
+                   steps=steps, arrival_ms=arrival, **kw)
+
+
+def _serve(tiny_pipe, reqs, timer=None, **kw):
+    timer = timer or VirtualTimer()
+
+    def factory(key, bucket):
+        return FakeRunner(key, bucket, timer)
+
+    return timer, serve_forever(tiny_pipe, reqs, runner_factory=factory,
+                                timer=timer, **kw)
+
+
+def _dumps(doc):
+    return json.dumps(doc, sort_keys=True)
+
+
+def _synth(site_durs, program="p", pool="mono", run_ms=8.0, tags=None,
+           vnow=(0.0, 16.0), mem_at=16.0):
+    """A WorkloadProfile with binary-exact values (sums stay exact, so
+    the fold-algebra equalities below compare bytes, not approximately).
+    """
+    doc = ps.empty_profile(tags if tags is not None else {"preset": "t"})
+    doc["window"] = {"first_vnow_ms": vnow[0], "last_vnow_ms": vnow[1],
+                     "runs": 1}
+    doc["captures"] = {"count": 1, "dispatches_seen": 4,
+                       "events_folded": 64}
+    doc["sites"] = [{"site": s, "dur_us": d, "slices": 2}
+                    for s, d in site_durs.items()]
+    doc["programs"] = [{"program": program, "pool": pool, "bucket": 1,
+                        "captures": 1, "run_ms_sum": run_ms,
+                        "mfu_pct_sum": 8.0, "mfu_samples": 1,
+                        "flops": 1024.0, "predicted_ms": 4.0}]
+    doc["phases"] = {pool: {"captures": 1, "run_ms_sum": run_ms}}
+    doc["kernels"] = [{"variant": "materialized", "ms": run_ms}]
+    doc["schedule_segments"] = [
+        {"site": s, "reuse": 0.25, "measured_ms": d / 1024.0}
+        for s, d in site_durs.items()]
+    doc["stage_histograms"] = {"serve_run_ms": [
+        {"labels": {"pool": pool}, "count": 2, "sum": 16.0,
+         "buckets": [[1.0, 1], [5.0, 2]]}]}
+    doc["device_memory"] = {"sampled_at_ms": mem_at, "bytes_in_use": 256}
+    doc["overhead"] = {"capture_ms": 2.0, "base_wall_ms": 8.0,
+                       "overhead_pct": 0.0}
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# Sampling plan
+# ---------------------------------------------------------------------------
+
+
+def test_sampling_plan_deterministic_seeded_and_pool_keyed():
+    plan = ps.SamplingPlan(seed=3, period=4)
+    picks = [plan.sampled("mono", i) for i in range(256)]
+    # Pure function of (seed, pool, ordinal): a restarted plan replays
+    # the identical decisions.
+    assert picks == [ps.SamplingPlan(seed=3, period=4).sampled("mono", i)
+                     for i in range(256)]
+    assert 0 < sum(picks) < 256          # samples SOME, not all
+    assert picks != [ps.SamplingPlan(seed=4, period=4).sampled("mono", i)
+                     for i in range(256)]
+    assert picks != [plan.sampled("phase1", i) for i in range(256)]
+    # period=1 short-circuits to always; period<1 is a loud config error.
+    assert all(ps.SamplingPlan(period=1).sampled("p", i) for i in range(8))
+    with pytest.raises(ValueError, match="period"):
+        ps.SamplingPlan(period=0)
+    assert plan.describe() == {"kind": "hash-mod", "seed": 3, "period": 4}
+
+
+# ---------------------------------------------------------------------------
+# Trace ring: caps soak, oversize survivor, orphan sweep
+# ---------------------------------------------------------------------------
+
+
+def _commit_one(ring, payload=2000):
+    seq = ring.next_seq()
+    d = ring.tmp_dir(seq)
+    with open(os.path.join(d, "payload.bin"), "wb") as f:
+        f.write(b"x" * payload)
+    ring.commit(d, seq)
+    return seq
+
+
+def test_trace_ring_count_and_byte_caps_soak(tmp_path):
+    ring = ps.TraceRing(str(tmp_path / "ring"), max_bytes=10_000,
+                        max_count=3)
+    for _ in range(12):
+        _commit_one(ring)
+        ring.gc()
+        st = ring.stats()
+        assert st["count"] <= 3 and st["bytes"] <= 10_000
+    names = [os.path.basename(d) for d in ring.captures()]
+    assert names[-1] == "cap-000011"       # newest survives every GC
+    assert names == sorted(names)          # oldest-first eviction
+    # Byte cap binds before the count cap when captures are fat.
+    ring2 = ps.TraceRing(str(tmp_path / "ring2"), max_bytes=5_000,
+                         max_count=16)
+    for _ in range(6):
+        _commit_one(ring2)
+        ring2.gc()
+    assert ring2.stats()["count"] == 2     # 3 × 2000 would breach 5000
+    with pytest.raises(ValueError, match="max_count"):
+        ps.TraceRing(str(tmp_path / "r3"), max_count=0)
+
+
+def test_trace_ring_single_oversize_capture_survives(tmp_path):
+    ring = ps.TraceRing(str(tmp_path / "ring"), max_bytes=10_000,
+                        max_count=3)
+    _commit_one(ring, payload=50_000)
+    evicted, freed = ring.gc()
+    # The newest capture is never evicted, even alone over the byte cap —
+    # a profiler that deletes its only evidence is useless.
+    assert evicted == 0 and freed == 0
+    assert ring.stats()["count"] == 1
+    _commit_one(ring, payload=100)
+    evicted, freed = ring.gc()
+    assert evicted == 1 and freed == 50_000
+
+
+def test_trace_ring_orphan_sweep_spares_committed(tmp_path):
+    root = str(tmp_path / "ring")
+    ring = ps.TraceRing(root)
+    _commit_one(ring)
+    d = ring.tmp_dir(7)                    # in-flight at crash time
+    with open(os.path.join(d, "t.json"), "w") as f:
+        f.write("{}")
+    assert ps.TraceRing(root).sweep_orphans() == 1
+    assert not glob.glob(os.path.join(root, "tmp-cap-*"))
+    assert len(ring.captures()) == 1       # committed capture untouched
+
+
+# ---------------------------------------------------------------------------
+# Fold algebra
+# ---------------------------------------------------------------------------
+
+
+def test_fold_profiles_commutative_and_associative():
+    a = _synth({"cross_attn/down0": 512.0, "self_attn/mid0": 256.0},
+               program="p1", pool="phase1", vnow=(0.0, 8.0), mem_at=8.0)
+    b = _synth({"cross_attn/down0": 256.0, "self_attn/up1": 1024.0},
+               program="p2", pool="phase2", vnow=(4.0, 32.0), mem_at=32.0,
+               tags={"preset": "t", "mesh": "dp=2"})
+    c = _synth({"self_attn/mid0": 128.0}, program="p1", pool="phase1",
+               run_ms=2.0, vnow=(64.0, 96.0), mem_at=96.0,
+               tags={"preset": "u"})
+    ab = ps.fold_profiles(a, b)
+    assert _dumps(ab) == _dumps(ps.fold_profiles(b, a))
+    assert _dumps(ps.fold_profiles(ab, c)) == \
+        _dumps(ps.fold_profiles(a, ps.fold_profiles(b, c)))
+    # The merged facts: sums by key, window hull, latest memory snapshot.
+    assert ab["window"] == {"first_vnow_ms": 0.0, "last_vnow_ms": 32.0,
+                            "runs": 2}
+    sites = {e["site"]: e for e in ab["sites"]}
+    assert sites["cross_attn/down0"]["dur_us"] == 768.0
+    assert sites["cross_attn/down0"]["slices"] == 4
+    assert ab["device_memory"]["sampled_at_ms"] == 32.0
+    assert len(ab["programs"]) == 2        # distinct (program, pool)
+    hist = ab["stage_histograms"]["serve_run_ms"]
+    # Buckets carry CUMULATIVE counts; the fold sums them elementwise.
+    by_pool = {h["labels"]["pool"]: h for h in hist}
+    assert by_pool["phase1"]["buckets"] == [[1.0, 1], [5.0, 2]]
+    # None/identity cases and the foreign-format guard.
+    assert _dumps(ps.fold_profiles(a, None)) == \
+        _dumps(ps.derive_profile(json.loads(_dumps(a))))
+    with pytest.raises(ValueError, match="format"):
+        ps.fold_profiles(a, {"format": "something-else"})
+
+
+def test_fold_tags_conflicts_become_mixed_sets():
+    ab = ps.fold_profiles(_synth({}, tags={"preset": "a", "m": 1}),
+                          _synth({}, tags={"preset": "b"}))
+    assert ab["tags"]["m"] == 1
+    assert ab["tags"]["preset"] == {"mixed": ['"a"', '"b"']}
+    # Mixed sets UNION on a further fold (associativity's hard case).
+    abc = ps.fold_profiles(ab, _synth({}, tags={"preset": "c"}))
+    assert abc["tags"]["preset"] == {"mixed": ['"a"', '"b"', '"c"']}
+
+
+def test_derive_profile_shares_sum_and_ordering():
+    doc = ps.fold_profiles(
+        _synth({"cross_attn/down0": 512.0, "self_attn/mid0": 1536.0}),
+        None)
+    assert sum(e["share"] for e in doc["sites"]) == 1.0
+    assert [e["site"] for e in doc["sites"]] == \
+        ["self_attn/mid0", "cross_attn/down0"]      # hottest first
+    prog = doc["programs"][0]
+    assert prog["run_ms_mean"] == 8.0
+    assert prog["measured_vs_predicted"] == 2.0     # 8 ms over 4 predicted
+    assert doc["overhead"]["overhead_pct"] == 25.0  # 2 ms over 8 ms
+    assert traceparse.validate_profile(doc) == []
+
+
+# ---------------------------------------------------------------------------
+# Drift sentinels + schedule-implied reuse
+# ---------------------------------------------------------------------------
+
+
+def test_drift_sentinel_warms_up_then_fires():
+    s = ps.DriftSentinel("predicted_ratio", threshold=0.25, min_samples=3)
+    assert s.observe("k", 1.0) is None       # n=1: EWMA init
+    assert s.observe("k", 1.0) is None       # n=2,3: under min_samples
+    assert s.observe("k", 1.0) is None
+    assert s.observe("k", 1.05) is None      # warm, but under threshold
+    ev = s.observe("k", 2.0)
+    assert ev is not None and ev["drift"] == "predicted_ratio"
+    assert ev["key"] == "k" and ev["deviation"] > 0.25
+    assert s.observe("other", 9.0) is None   # keys track independently
+
+
+def test_schedule_reuse_table_values_are_flip_points():
+    sched = {"cfg_gate": 0.25, "cross": {"*": 0.25},
+             "self": {"self_attn/mid0": 0.5, "*": "auto"}}
+    # A site flipping to cached reuse at 25% of the run spends 75% of
+    # its steps on the reuse variant — 1 - flip, not the raw table value.
+    assert ps._schedule_reuse(sched, "cross_attn/down0") == 0.75
+    assert ps._schedule_reuse(sched, "self_attn/mid0") == 0.5
+    assert ps._schedule_reuse(sched, "self_attn/up1") == 0.5   # "auto"
+    assert ps._schedule_reuse({"cfg_gate": 4}, "cross_attn/x") == 0.0
+    assert ps._schedule_reuse(None, "cross_attn/x") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# traceparse: op→site join + loud format confusion
+# ---------------------------------------------------------------------------
+
+
+_HLO = """\
+%fused_comp (p.0: f32[2]) -> f32[2] {
+  %a.1 = f32[2] add(%p.0, %p.0), metadata={op_name="jit(f)/cross_attn/down0/q"}
+  %b.2 = f32[2] multiply(%a.1, %a.1), metadata={op_name="jit(f)/cross_attn/down0/k"}
+  %c.3 = f32[2] add(%b.2, %b.2), metadata={op_name="jit(f)/self_attn/mid0/v"}
+}
+ENTRY %main (x.4: f32[2]) -> f32[2] {
+  %dot.5 = f32[2] dot(%x.4, %x.4), metadata={op_name="jit(f)/self_attn/up1/qk"}
+  ROOT %fusion.7 = f32[2] fusion(%x.4), kind=kLoop, calls=%fused_comp
+}
+"""
+
+
+def test_op_site_index_joins_bare_hlo_events_to_sites():
+    idx = traceparse.op_site_index(_HLO)
+    assert idx["dot.5"] == "self_attn/up1"
+    # A fusion is attributed to the DOMINANT site of its called
+    # computation (2 cross_attn/down0 members vs 1 self_attn/mid0).
+    assert idx["fusion.7"] == "cross_attn/down0"
+    events = [
+        {"name": "fusion.7", "dur": 12.0, "args": {"hlo_op": "fusion.7"}},
+        {"name": "dot.5", "dur": 6.0},                 # bare-name fallback
+        {"name": "thunk:cross_attn/down0", "dur": 4.0},  # named_scope path
+        {"name": "unrelated.9", "dur": 99.0},
+    ]
+    folded = traceparse.fold_site_events(events, idx)
+    by = {e["site"]: e for e in folded}
+    assert by["cross_attn/down0"]["dur_us"] == 16.0
+    assert by["self_attn/up1"]["dur_us"] == 6.0
+    assert sum(e["share"] for e in folded) == 1.0
+    # Without the index, bare HLO names resolve no sites at all.
+    assert traceparse.fold_site_events(events[:2], None) == []
+
+
+def test_format_confusion_is_loud_both_ways(tmp_path):
+    ledger = str(tmp_path / "workload_profile.json")
+    with open(ledger, "w") as f:
+        json.dump(ps.fold_profiles(_synth({"cross_attn/down0": 8.0}),
+                                   None), f)
+    trace = str(tmp_path / "trace.json")
+    with open(trace, "w") as f:
+        json.dump({"traceEvents": [{"name": "cross_attn/down0",
+                                    "dur": 5.0}]}, f)
+    # A ledger where a trace is expected names the right flag...
+    with pytest.raises(ValueError, match="WorkloadProfile ledger"):
+        traceparse.load_trace_events(ledger)
+    # ...and a trace where a ledger is expected names the other.
+    with pytest.raises(ValueError, match="chrome trace"):
+        traceparse.load_workload_profile(trace)
+    with pytest.raises(ValueError, match="not a WorkloadProfile"):
+        traceparse.load_workload_profile(os.path.join(
+            REPO, "tools", "cost_budgets.json"))
+    # parse_sites_any sniffs by content, preserving each loud error.
+    entries, kind = traceparse.parse_sites_any(ledger)
+    assert kind == "profile" and entries[0]["site"] == "cross_attn/down0"
+    entries, kind = traceparse.parse_sites_any(trace)
+    assert kind == "trace" and entries[0]["dur_us"] == 5.0
+    # A captureless ledger is a loud "no measured sites", never empty.
+    with pytest.raises(ValueError, match="no measured sites"):
+        traceparse.profile_sites(ps.empty_profile())
+
+
+def test_validate_profile_reports_schema_problems():
+    doc = ps.fold_profiles(_synth({"cross_attn/down0": 8.0}), None)
+    assert traceparse.validate_profile(doc) == []
+    broken = json.loads(_dumps(doc))
+    del broken["kernels"]
+    broken["overhead"]["overhead_pct"] = -1.0
+    broken["sites"][0]["share"] = 0.25
+    problems = traceparse.validate_profile(broken)
+    assert any("kernels" in p for p in problems)
+    assert any("overhead_pct" in p for p in problems)
+    assert any("shares sum" in p for p in problems)
+    assert traceparse.validate_profile([]) == ["not an object: list"]
+
+
+# ---------------------------------------------------------------------------
+# Serve-engine integration (fake runners, virtual clock)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_captures_fold_into_valid_ledger(tiny_pipe, tmp_path):
+    out = str(tmp_path / "prof")
+    scope = ps.ProdScope(out, period=1, tags={"preset": "tiny"})
+    reqs = [_req("a"), _req("b", arrival=5.0)]
+    _, gen = _serve(tiny_pipe, reqs, prodscope=scope, max_batch=2,
+                    max_wait_ms=10.0)
+    recs = list(gen)
+    summary = recs[-1]
+    assert summary["status"] == "summary"
+    prof = summary["profile"]
+    assert prof["captures"] >= 1
+    assert prof["dispatches_seen"] >= prof["captures"]
+    assert prof["sampling"] == {"kind": "hash-mod", "seed": 0,
+                                "period": 1}
+    doc = traceparse.load_workload_profile(
+        os.path.join(out, "workload_profile.json"))
+    assert traceparse.validate_profile(doc) == []
+    assert doc["captures"]["count"] == prof["captures"]
+    # Every committed capture carries its tagged meta.json, including
+    # the device-memory snapshot hook (ISSUE 18 satellite).
+    metas = sorted(glob.glob(os.path.join(out, "ring", "cap-*",
+                                          "meta.json")))
+    assert metas
+    with open(metas[0]) as f:
+        meta = json.load(f)
+    assert {"seq", "pool", "bucket", "sampling", "tags", "sites",
+            "device_memory"} <= set(meta)
+    assert meta["tags"]["preset"] == "tiny"
+    # Restart continuity: a new scope on the same directory folds the
+    # next session into the on-disk ledger.
+    scope2 = ps.ProdScope(out, period=1, tags={"preset": "tiny"})
+    _, gen2 = _serve(tiny_pipe, [_req("c")], prodscope=scope2,
+                     max_batch=2, max_wait_ms=10.0)
+    list(gen2)
+    merged = scope2.ledger()
+    assert merged["window"]["runs"] == 2
+    assert merged["captures"]["count"] > prof["captures"]
+
+
+def test_serve_unsampled_run_writes_captureless_ledger(tiny_pipe,
+                                                       tmp_path):
+    # A huge period on a tiny run may sample nothing: the ledger must
+    # still be written, valid, and loud (via profile_sites) about
+    # carrying no measured sites.
+    out = str(tmp_path / "prof")
+    scope = ps.ProdScope(out, seed=1, period=10_000)
+    _, gen = _serve(tiny_pipe, [_req("a")], prodscope=scope, max_batch=2,
+                    max_wait_ms=10.0)
+    recs = list(gen)
+    scope.write_ledger()
+    doc = traceparse.load_workload_profile(
+        os.path.join(out, "workload_profile.json"))
+    assert traceparse.validate_profile(doc) == []
+    if recs[-1]["profile"]["captures"] == 0:
+        with pytest.raises(ValueError, match="no measured sites"):
+            traceparse.profile_sites(doc)
+
+
+def test_chaos_kill_during_capture_orphan_swept_exactly_once(
+        tiny_pipe, tmp_path):
+    wal = str(tmp_path / "k.wal")
+    out = str(tmp_path / "prof")
+    plan = FaultPlan(by_batch={1: "kill_during_capture"})
+    scope = ps.ProdScope(out, period=1)
+    journal = Journal(wal)
+    reqs = [_req(f"r{i}", arrival=i * 5.0, steps=4 + i) for i in range(3)]
+    _, gen = _serve(tiny_pipe, reqs, journal=journal, chaos=plan,
+                    prodscope=scope, max_batch=2, max_wait_ms=10.0)
+    recs = []
+    with pytest.raises(SimulatedKill):
+        for rec in gen:
+            recs.append(rec)
+    journal._f.close()     # simulated process death
+    served1 = {r["request_id"] for r in recs if r["status"] == "ok"}
+    assert served1, "batch 1 completed before the kill"
+    # Died after the tmp trace was durable, before the commit rename:
+    # exactly the orphan window. Nothing was committed into the ring.
+    orphans = glob.glob(os.path.join(out, "ring", "tmp-cap-*"))
+    assert orphans, "the kill must land inside the orphan window"
+    assert glob.glob(os.path.join(out, "ring", "cap-*")) == []
+    # Restart: the new scope's startup sweep collects the orphan, and
+    # the journal replay keeps serving exactly-once.
+    scope2 = ps.ProdScope(out, period=1)
+    assert scope2.orphans_swept == len(orphans)
+    assert glob.glob(os.path.join(out, "ring", "tmp-cap-*")) == []
+    journal2 = Journal(wal)
+    _, gen2 = _serve(tiny_pipe, reqs, journal=journal2, prodscope=scope2,
+                     max_batch=2, max_wait_ms=10.0)
+    recs2 = list(gen2)
+    journal2.close()
+    served2 = {r["request_id"] for r in recs2 if r["status"] == "ok"}
+    assert served1 | served2 == {r.request_id for r in reqs}
+    assert not served1 & served2, "exactly-once across the kill"
+    assert recs2[-1]["profile"]["orphans_swept"] == len(orphans)
+
+
+def test_profile_off_adds_no_summary_block_or_metric_families(
+        tiny_pipe, tmp_path):
+    from p2p_tpu.obs.metrics import Registry
+
+    _, gen = _serve(tiny_pipe, [_req("a")], max_batch=2, max_wait_ms=10.0)
+    recs = list(gen)
+    assert "profile" not in recs[-1]      # summary block only when on
+    # serve_profile_* families exist only once a ProdScope constructs —
+    # a profile-less run's registry snapshot stays byte-identical.
+    reg = Registry()
+    assert not [n for n in reg.snapshot()
+                if str(n).startswith("serve_profile_")]
+    ps.ProdScope(str(tmp_path / "p"), registry=reg)
+    assert [n for n in reg.snapshot()
+            if str(n).startswith("serve_profile_")]
+
+
+# ---------------------------------------------------------------------------
+# Satellites: perfscope + schedule_search consume the ledger
+# ---------------------------------------------------------------------------
+
+
+def _load_tool(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        f"p2p_{name}", os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _layout_ledger(tmp_path, dur_us=2048.0):
+    from p2p_tpu.engine.reuse import site_name
+    from p2p_tpu.models import TINY
+    from p2p_tpu.models.config import unet_layout
+
+    names = [site_name(m) for m in unet_layout(TINY.unet).metas]
+    durs = {s: dur_us * (i + 1) for i, s in enumerate(names)}
+    doc = ps.fold_profiles(_synth(durs), None)
+    path = str(tmp_path / "workload_profile.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path, names
+
+
+def test_perfscope_sites_accepts_workload_profile(tmp_path, capsys):
+    perfscope = _load_tool("perfscope")
+    path, names = _layout_ledger(tmp_path)
+    assert perfscope.main(["--sites", path]) == 0
+    out = capsys.readouterr().out
+    assert "(profile)" in out
+    # --fuse-plan from a ledger ranks by MEASURED ms × map bytes and
+    # stamps the artifact's source as "profile".
+    plan_path = str(tmp_path / "plan.json")
+    assert perfscope.main(["--sites", path, "--fuse-plan", plan_path,
+                           "--plan-config", "tiny"]) == 0
+    with open(plan_path) as f:
+        plan = json.load(f)
+    assert plan["source"] == "profile"
+    assert all("measured_ms" in e for e in plan["fuse_order"])
+    assert "meas ms" in perfscope.render_fuse_plan(plan)
+    # A chrome trace still reports source "trace" (shares only).
+    entries, kind = perfscope.parse_sites_any(os.path.join(
+        REPO, "tests", "data", "site_trace_tiny.json"))
+    assert kind == "trace"
+    assert perfscope.fuse_plan(entries, config="tiny")["source"] == "trace"
+
+
+def test_schedule_search_seeds_from_profile_ledger(tmp_path, tiny_pipe):
+    search = _load_tool("schedule_search")
+    path, _ = _layout_ledger(tmp_path)
+    out = str(tmp_path / "found.json")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rc = search.main(["--profile", path, "--steps", "8",
+                          "--groups", "1", "--reps", "1",
+                          "--max-evals", "2", "--gate-grid", "0.5",
+                          "--grid", "0.62", "--out", out])
+    assert rc == 0
+    with open(out) as f:
+        spec = json.load(f)
+    assert spec["provenance"]["sites_source"] == path
+    # Format confusion: a chrome trace handed to --profile is a loud
+    # exit 2, and the two seed flags are mutually exclusive.
+    trace = os.path.join(REPO, "tests", "data", "site_trace_tiny.json")
+    assert search.main(["--profile", trace, "--max-evals", "1"]) == 2
+    with pytest.raises(SystemExit):
+        search.main(["--profile", path, "--sites-json", path])

@@ -20,7 +20,11 @@ peak table, measured MFU, and the frozen-budget diff the quality gate's
     python tools/perfscope.py --sites TRACE --fuse-plan out.json
                                                # rank sites fuse-first
                                                # (share x map bytes) for
-                                               # KernelConfig.from_fuse_plan
+                                               # KernelConfig.from_fuse_plan;
+                                               # TRACE may be a serve
+                                               # --profile WorkloadProfile
+                                               # ledger (measured ms x map
+                                               # bytes scoring)
     python tools/perfscope.py --json out.json  # structured report
 
 ``--headline`` recomputes "89 TF/s ≈ 45% MFU at 40.75 ms/step" from the
@@ -97,63 +101,15 @@ def render_cards(cards: dict, peaks) -> str:
     return "\n".join(lines)
 
 
-_SITE_RE = None
-
-
-def parse_site_trace(path: str) -> list:
-    """Aggregate per-attention-site device time from a Perfetto/Chrome
-    trace (ISSUE 15, the schedule search's seed input).
-
-    Every attention site is wrapped in a ``jax.named_scope`` whose name
-    (``cross_attn/down3``) lands in the HLO op metadata, so device slices
-    in a ``jax.profiler`` / ``serve --trace-out`` export carry the site
-    name inside the op name. Events are matched by that embedded name
-    (complete-duration ``X`` events and begin/end pairs both carry
-    ``dur``), durations summed per site, shares normalized over all
-    matched sites. Accepts a raw chrome-trace JSON (a ``traceEvents``
-    object or a bare event list), ``.gz``-compressed or not."""
-    import gzip
-    import re
-
-    global _SITE_RE
-    if _SITE_RE is None:
-        _SITE_RE = re.compile(r"(cross_attn|self_attn)/(?:down|mid|up)\d+")
-    opener = gzip.open if path.endswith(".gz") else open
-    with opener(path, "rt") as f:
-        data = json.load(f)
-    events = data.get("traceEvents", data) if isinstance(data, dict) \
-        else data
-    if not isinstance(events, list):
-        raise ValueError(f"{path}: not a chrome-trace (no traceEvents "
-                         "list)")
-    durs: dict = {}
-    counts: dict = {}
-    for e in events:
-        if not isinstance(e, dict):
-            continue
-        name = e.get("name")
-        dur = e.get("dur")
-        if not name or dur is None:
-            continue
-        m = _SITE_RE.search(str(name))
-        if not m:
-            continue
-        site = m.group(0)
-        durs[site] = durs.get(site, 0.0) + float(dur)
-        counts[site] = counts.get(site, 0) + 1
-    total = sum(durs.values())
-    if not total:
-        raise ValueError(
-            f"{path}: no attention-site slices found — is this a DEVICE "
-            "trace of a named_scope-instrumented program? (site names "
-            "look like 'cross_attn/down3')")
-    return [{"site": s, "dur_us": durs[s], "slices": counts[s],
-             "share": durs[s] / total}
-            for s in sorted(durs, key=lambda s: -durs[s])]
+# The named_scope trace parser moved to the shared module (ISSUE 18) so
+# the serve engine's production profiler folds traces through the same
+# code path; re-exported here for import compatibility.
+from p2p_tpu.obs.traceparse import (  # noqa: E402
+    parse_site_trace, parse_sites_any)
 
 
 def fuse_plan(entries: list, config: str = "sd14",
-              group_batch: int = 1) -> dict:
+              group_batch: int = 1, source: str = "trace") -> dict:
     """Rank attention sites fuse-first (ISSUE 16): measured step-time share
     (a ``--sites`` trace table) × the bytes the materialized probability
     map moves per step (``2B·heads·P·K·4``, the f32 softmax the fused-edit
@@ -165,7 +121,13 @@ def fuse_plan(entries: list, config: str = "sd14",
     still fuses them); trace sites unknown to ``config``'s layout are
     dropped LOUDLY in the returned ``dropped`` list, never silently.
     The emitted ``fuse_order`` is exactly what
-    ``kernels.KernelConfig.from_fuse_plan`` consumes."""
+    ``kernels.KernelConfig.from_fuse_plan`` consumes.
+
+    With ``source="profile"`` (ISSUE 18: entries from a WorkloadProfile
+    ledger, which carry absolute ``dur_us``) the score upgrades from
+    relative share to measured ms × map bytes, and each ranked site
+    records its ``measured_ms`` — same ordering semantics, better units.
+    """
     from p2p_tpu.engine.reuse import site_name
     from p2p_tpu.models.config import PRESET_CONFIGS, unet_layout
 
@@ -175,28 +137,40 @@ def fuse_plan(entries: list, config: str = "sd14",
     metas = {site_name(m): m
              for m in unet_layout(PRESET_CONFIGS[config].unet).metas}
     shares = {e["site"]: e["share"] for e in entries}
+    durs = {e["site"]: e.get("dur_us") for e in entries}
+    use_ms = source == "profile" and all(
+        durs.get(s) is not None for s in shares)
     dropped = sorted(set(shares) - set(metas))
     order = []
     for name, m in metas.items():
         share = shares.get(name, 0.0)
         map_bytes = 2 * group_batch * m.heads * m.pixels * m.key_len * 4
-        order.append({"site": name, "share": share,
-                      "map_bytes": map_bytes,
-                      "score": share * map_bytes,
-                      "measured": name in shares})
+        entry = {"site": name, "share": share,
+                 "map_bytes": map_bytes,
+                 "score": share * map_bytes,
+                 "measured": name in shares}
+        if use_ms:
+            ms = (durs.get(name) or 0.0) / 1e3
+            entry["measured_ms"] = ms
+            entry["score"] = ms * map_bytes
+        order.append(entry)
     order.sort(key=lambda d: (-d["score"], -d["map_bytes"]))
     return {"config": config, "group_batch": group_batch,
+            "source": source if use_ms else "trace",
             "fuse_order": order, "dropped": dropped}
 
 
 def render_fuse_plan(plan: dict) -> str:
+    profiled = plan.get("source") == "profile"
+    ms_col = f" {'meas ms':>8s}" if profiled else ""
     lines = [f"  {'site':22s} {'share':>7s} {'map MiB':>9s} "
-             f"{'score':>10s}"]
+             f"{'score':>10s}{ms_col}"]
     for e in plan["fuse_order"]:
         mark = "" if e["measured"] else "  (unmeasured)"
+        ms = f" {e.get('measured_ms', 0.0):>8.3f}" if profiled else ""
         lines.append(f"  {e['site']:22s} {e['share'] * 100:>6.1f}% "
                      f"{e['map_bytes'] / 2**20:>9.2f} "
-                     f"{e['score']:>10.3g}{mark}")
+                     f"{e['score']:>10.3g}{ms}{mark}")
     if plan["dropped"]:
         lines.append(f"  dropped {len(plan['dropped'])} trace site(s) not "
                      f"in the {plan['config']!r} layout: "
@@ -248,12 +222,15 @@ def main(argv=None) -> int:
     ap.add_argument("--programs", default=None, metavar="FILE",
                     help="render a serve --programs-out JSONL artifact "
                          "instead of compiling the canonical programs")
-    ap.add_argument("--sites", default=None, metavar="TRACE",
+    ap.add_argument("--sites", default=None, metavar="TRACE|PROFILE",
                     help="render the per-attention-site step-time share "
                          "table from a recorded Perfetto/chrome device "
-                         "trace (named_scope site names) — the reuse-"
-                         "schedule search's seed input "
-                         "(tools/schedule_search.py --sites-json)")
+                         "trace (named_scope site names) OR a serve "
+                         "--profile WorkloadProfile ledger (auto-"
+                         "detected by content) — the reuse-schedule "
+                         "search's seed input "
+                         "(tools/schedule_search.py --sites-json / "
+                         "--profile)")
     ap.add_argument("--fuse-plan", default=None, metavar="FILE",
                     help="with --sites: write the ranked fuse-first site "
                          "list (measured step-time share × materialized-"
@@ -299,17 +276,20 @@ def main(argv=None) -> int:
 
     if args.sites:
         try:
-            entries = parse_site_trace(args.sites)
+            entries, kind = parse_sites_any(args.sites)
         except (OSError, ValueError) as e:
             print(f"--sites: {e}", file=sys.stderr)
             return 2
-        print(f"{len(entries)} attention site(s) from {args.sites}")
+        print(f"{len(entries)} attention site(s) from {args.sites} "
+              f"({kind})")
         print(render_sites(entries))
         report["sites"] = entries
+        report["sites_source"] = kind
         if args.fuse_plan:
             try:
                 plan = fuse_plan(entries, config=args.plan_config,
-                                 group_batch=args.group_batch)
+                                 group_batch=args.group_batch,
+                                 source=kind)
             except ValueError as e:
                 print(f"--fuse-plan: {e}", file=sys.stderr)
                 return 2

@@ -466,6 +466,91 @@ def _flight_parity():
             n_attr_ok, chain_ok)
 
 
+def _profile_parity(overhead_bound: float):
+    """The production-profiling neutrality contract (ISSUE 18): serving
+    the same trace with a ProdScope attached must leave (1) every output
+    image bitwise identical, (2) the serve JSONL record stream
+    byte-identical once the summary record's ``profile`` block is
+    stripped (the only record addition the profiler is allowed), and
+    (3) the journal byte-identical once the profiler's own
+    ``profile_drift`` EVENT lines are stripped (the only journal
+    addition the profiler is allowed) — while (4) capturing at
+    least one sampled device trace, (5) writing a ledger that validates
+    against the WorkloadProfile schema, and (6) keeping the recorded
+    capture overhead under ``overhead_bound`` percent. Returns
+    (records_identical, images_identical, journal_identical, captures,
+    schema_problems, overhead_pct)."""
+    import json
+    import tempfile
+
+    import numpy as np
+
+    from p2p_tpu.obs import metrics as obs_metrics
+    from p2p_tpu.obs import prodscope as obs_prodscope
+    from p2p_tpu.obs import traceparse
+    from p2p_tpu.serve import Journal, Request, serve_forever
+    from tests.test_golden import _pipe
+    from p2p_tpu.models import TINY
+
+    pipe = _pipe(TINY)
+    prompts = ["a squirrel eating a burger", "a squirrel eating a lasagna"]
+    reqs = [Request(request_id="pp-gated", prompt=prompts[0],
+                    target=prompts[1], mode="replace", steps=3, seed=42,
+                    gate=0.5, arrival_ms=0.0),
+            Request(request_id="pp-plain", prompt=prompts[0], steps=3,
+                    seed=7, arrival_ms=1.0)]
+
+    def run(tmp, scope):
+        # Deterministic timer (the flight_parity discipline): the
+        # byte-compare isolates the profiler's effect on the record
+        # stream, not cross-run timing noise.
+        obs_metrics.registry().reset()
+        jpath = os.path.join(tmp, "journal.jsonl")
+        journal = Journal(jpath)
+        try:
+            recs = list(serve_forever(pipe, list(reqs), max_batch=4,
+                                      max_wait_ms=1.0, timer=lambda: 0.0,
+                                      journal=journal, prodscope=scope))
+        finally:
+            journal.close()
+        imgs = {r["request_id"]: r["images"] for r in recs
+                if r["status"] == "ok"}
+        # The summary record's "profile" block is the one record
+        # addition the profiler is allowed; everything else must match.
+        stripped = [{k: v for k, v in r.items()
+                     if k not in ("images", "profile")} for r in recs]
+        with open(jpath) as f:
+            # Carry-spill paths embed the per-run journal directory;
+            # normalize so the byte-compare sees only real divergence.
+            jlines = [ln.replace(tmp, "<TMP>") for ln in f]
+        return json.dumps(stripped, sort_keys=True), imgs, jlines, recs[-1]
+
+    with tempfile.TemporaryDirectory() as t_off, \
+            tempfile.TemporaryDirectory() as t_on:
+        base_bytes, base_imgs, base_j, _ = run(t_off, None)
+        # period=1: every dispatch sampled — this tiny trace has too few
+        # dispatches for a sparse plan to be guaranteed a capture.
+        scope = obs_prodscope.ProdScope(os.path.join(t_on, "profile"),
+                                        seed=0, period=1,
+                                        tags={"preset": "tiny"})
+        on_bytes, on_imgs, on_j, summary = run(t_on, scope)
+        ledger = scope.ledger()
+
+    records_identical = base_bytes == on_bytes
+    images_identical = (set(base_imgs) == set(on_imgs) and all(
+        np.array_equal(base_imgs[k], on_imgs[k]) for k in base_imgs))
+    # The profiler's one permitted journal addition: profile_drift EVENT
+    # lines (none expected at this scale — the sentinels' min_samples
+    # suppresses short-run noise — but stripped defensively).
+    on_j = [ln for ln in on_j if '"profile_drift"' not in ln]
+    journal_identical = base_j == on_j
+    prof = summary.get("profile", {})
+    problems = traceparse.validate_profile(ledger)
+    return (records_identical, images_identical, journal_identical,
+            int(prof.get("captures", 0)), problems,
+            float(prof.get("overhead_pct", 0.0)))
+
+
 def _lifecycle():
     """The lifecycle-durability contract (ISSUE 9), gated on the chaos
     drill's rolling-restart leg: a deterministic (zero-timer) seeded
@@ -737,6 +822,23 @@ def main(argv=None) -> int:
                          "dp=4 chaos drill on the virtual 8-device mesh)")
     ap.add_argument("--skip-flight", action="store_true",
                     help="skip the flight-tracing parity check (ISSUE 7)")
+    ap.add_argument("--skip-profile", action="store_true",
+                    help="skip the production-profiling parity check "
+                         "(ISSUE 18; ~15s: serves the 2-request gated "
+                         "trace with and without a ProdScope at "
+                         "period=1 and byte-compares records, images "
+                         "and journal)")
+    ap.add_argument("--profile-overhead-bound", type=float, default=5000.0,
+                    metavar="PCT",
+                    help="max recorded capture overhead_pct for the "
+                         "profile_parity leg (default %(default)s). A "
+                         "pathology-catcher, not a precision target: the "
+                         "leg samples EVERY dispatch of a 3-step tiny-CPU "
+                         "trace, so trace start/stop + parse dwarfs the "
+                         "sub-ms device work (~1000%% observed); a real "
+                         "deployment samples 1/N of multi-second "
+                         "dispatches. The bench 'serve.profile' block "
+                         "records the trustworthy per-round number")
     ap.add_argument("--bench-trend", action="store_true",
                     help="also run the opt-in bench_trend check: diff the "
                          "latest committed BENCH_r*.json round against its "
@@ -804,14 +906,14 @@ def main(argv=None) -> int:
                                        "bench_trend", "lifecycle", "soak",
                                        "mesh_parity", "slo", "cache_parity",
                                        "cost_regression", "schedule",
-                                       "kernel_parity"}
+                                       "kernel_parity", "profile_parity"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
                      f"valid: {', '.join(cases)}, phase_gate, serve_parity, "
                      f"obs_overhead, fault_drill, static_analysis, "
                      f"flight_parity, bench_trend, lifecycle, soak, "
                      f"mesh_parity, slo, cache_parity, cost_regression, "
-                     f"schedule, kernel_parity")
+                     f"schedule, kernel_parity, profile_parity")
 
     drifted = []
     for name, fn in cases.items():
@@ -894,6 +996,21 @@ def main(argv=None) -> int:
               f"{'ok' if ok else 'DRIFT'}")
         if not ok:
             drifted.append("flight_parity")
+
+    if not args.skip_profile and (only is None or "profile_parity" in only):
+        (rec_id, img_id, j_id, captures, problems,
+         overhead) = _profile_parity(args.profile_overhead_bound)
+        ok = (rec_id and img_id and j_id and captures >= 1
+              and not problems and overhead <= args.profile_overhead_bound)
+        print(f"{'profile_parity':16s} records "
+              f"{'byte-identical' if rec_id else 'DIFF'}, images "
+              f"{'bitwise' if img_id else 'DIFF'}, journal "
+              f"{'byte-identical' if j_id else 'DIFF'}, {captures} "
+              f"capture(s), schema "
+              f"{'clean' if not problems else problems}, "
+              f"overhead +{overhead:.0f}% {'ok' if ok else 'DRIFT'}")
+        if not ok:
+            drifted.append("profile_parity")
 
     if args.bench_trend or (only is not None and "bench_trend" in only):
         # Opt-in: the committed BENCH trajectory is only diffable when the

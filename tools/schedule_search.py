@@ -289,6 +289,12 @@ def main(argv=None) -> int:
                     help="measured per-site share table (the JSON "
                          "tools/perfscope.py --sites emits) to seed the "
                          "refinement order instead of the analytic model")
+    ap.add_argument("--profile", default=None, metavar="LEDGER",
+                    help="seed the refinement order from a serve "
+                         "--profile WorkloadProfile ledger's measured "
+                         "per-site shares (ISSUE 18: the engine-captured "
+                         "equivalent of --sites-json — no hand-collected "
+                         "trace). Mutually exclusive with --sites-json")
     ap.add_argument("--out", default=None, metavar="FILE",
                     help="write the winning schedule artifact here")
     ap.add_argument("--preset", default="tiny",
@@ -305,12 +311,29 @@ def main(argv=None) -> int:
     pipe = _pipe(cfg)
     layout = unet_layout(cfg.unet)
 
+    if args.profile and args.sites_json:
+        ap.error("--profile and --sites-json both seed the measured "
+                 "share table — pass one")
     shares = None
+    shares_src = None
     if args.sites_json:
         with open(args.sites_json) as f:
             data = json.load(f)
         shares = {e["site"]: e["share"] for e in data["sites"]}
-        print(f"seeded by measured shares: {args.sites_json} "
+        shares_src = args.sites_json
+    elif args.profile:
+        from p2p_tpu.obs import traceparse
+
+        try:
+            doc = traceparse.load_workload_profile(args.profile)
+            entries = traceparse.profile_sites(doc)
+        except (OSError, ValueError) as e:
+            print(f"--profile: {e}", file=sys.stderr)
+            return 2
+        shares = {e["site"]: e["share"] for e in entries}
+        shares_src = args.profile
+    if shares is not None:
+        print(f"seeded by measured shares: {shares_src} "
               f"({len(shares)} sites)")
 
     print(f"baseline: ungated {args.steps}-step replace edit, "
@@ -342,6 +365,8 @@ def main(argv=None) -> int:
             "measured_mse": r["mse"],
             "evals": ev.evals,
         }
+        if shares_src is not None:
+            spec["provenance"]["sites_source"] = shares_src
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w") as f:
             json.dump(spec, f, indent=2)
