@@ -1239,6 +1239,29 @@ def _measure(preset):
                     "drift_events": prof["drift_events"],
                 }
 
+            # Elastic mesh serving (ISSUE 19): the three-leg elastic drill
+            # (tools/chaos_drill.elastic_resize_drill, the same scenario
+            # the quality gate's `elastic` check enforces) — a seeded
+            # diurnal pressure trace the engine must ride by resizing dp
+            # up AND down with zero drops, fixed-topology parity within
+            # the documented vmap tolerance (±1 uint8 step), and a
+            # mid-resize kill that must restart on the WAL-recorded
+            # target topology and resume every parked carry off its
+            # spill, exactly-once. The headline key is
+            # cutover_pause_p95_ms — how long in-flight phase-2 work sat
+            # parked across a cutover (watched by tools/benchwatch.py,
+            # lower is better); the drill runs real runners on its
+            # deterministic virtual clock, so the sub-record is
+            # byte-stable across rounds and hosts. Needs >= 4 devices
+            # for the 1<->2<->4 dp swing (the rehearsal inherits the
+            # virtual 8-device CPU platform; a bare host without a mesh
+            # simply omits the sub-record, like a narrowed secondary).
+            if len(jax.devices()) >= 4:
+                with tempfile.TemporaryDirectory() as etmp:
+                    extras["serve"]["elastic"] = _load_tool(
+                        "chaos_drill").elastic_resize_drill(
+                            pipe, os.path.join(etmp, "elastic.wal"))
+
         # Telemetry-overhead block (ISSUE 3): the same headline single-group
         # edit run with the obs instrumentation enabled (phase-tagged step
         # callbacks traced in, host collector installed) vs disabled, so

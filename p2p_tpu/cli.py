@@ -497,6 +497,14 @@ def cmd_serve(args) -> int:
             mesh_spec = parse_mesh(args.mesh)
         except ValueError as e:
             raise SystemExit(str(e))
+    elastic_cfg = None
+    if args.elastic is not None:
+        from .serve import parse_elastic
+
+        try:
+            elastic_cfg = parse_elastic(args.elastic)
+        except ValueError as e:
+            raise SystemExit(str(e))
     # One serve run == one snapshot/event-log: reset before the pipeline
     # build so prewarm compiles and the queue/batcher/cache timelines are
     # all covered by the exported artifacts.
@@ -615,6 +623,11 @@ def cmd_serve(args) -> int:
                   "--profile is off — there is no capture to die inside "
                   "and the orphan-sweep path is NOT being drilled",
                   file=sys.stderr)
+        if "kill_during_resize" in kinds and args.elastic is None:
+            print("warning: chaos plan arms 'kill_during_resize' but "
+                  "--elastic is off — no resize ever runs, the kill "
+                  "never fires and the mid-resize crash window is NOT "
+                  "being drilled", file=sys.stderr)
     degrade = None
     if args.degrade_depth is not None:
         degrade = DegradeConfig(depth_threshold=args.degrade_depth,
@@ -692,6 +705,7 @@ def cmd_serve(args) -> int:
                     phase_pools=not args.single_pool,
                     phase2_max_batch=args.phase2_max_batch,
                     mesh=mesh_spec,
+                    elastic=elastic_cfg,
                     slo=slo,
                     semcache=semcache,
                     costscope=costscope,
@@ -1000,6 +1014,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "identical to serving without the flag; journal/"
                         "drain/crash semantics are mesh-agnostic "
                         "(docs/SERVING.md#mesh-parallel-serving)")
+    s.add_argument("--elastic", default=None, nargs="?", const="on",
+                   metavar="on|k=v,...",
+                   help="elastic mesh serving: a pressure-driven controller "
+                        "resizes the data-parallel mesh between powers of "
+                        "two while serving (prewarm-before-cutover, "
+                        "journaled resize protocol, in-flight work parks "
+                        "and resumes exactly-once). 'on' takes the "
+                        "defaults; otherwise a comma list over up_depth/"
+                        "up_window_ms/down_depth/down_window_ms/"
+                        "cooldown_ms/min_dp/max_dp. Combines with --mesh "
+                        "as the starting topology (default dp=1) — "
+                        "docs/SERVING.md#elastic-meshes")
     s.add_argument("--single-pool", action="store_true",
                    help="disable phase-disaggregated continuous batching: "
                         "gated requests run their monolithic program in "

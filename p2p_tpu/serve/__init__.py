@@ -18,6 +18,7 @@ from snapshot + WAL tail. See docs/SERVING.md.
 
 from .batcher import BUCKET_SIZES, DynamicBatcher, bucket_for
 from .chaos import FaultPlan, SimulatedKill
+from .elastic import ElasticConfig, ElasticController, parse_elastic
 from .engine_loop import DegradeConfig, serve_forever
 from .faults import InjectedFault, RetryPolicy, WatchdogTimeout, classify
 from .handoff import HandoffEntry
@@ -37,6 +38,8 @@ __all__ = [
     "DegradeConfig",
     "DrainController",
     "DynamicBatcher",
+    "ElasticConfig",
+    "ElasticController",
     "FairClock",
     "FaultPlan",
     "HandoffEntry",
@@ -56,6 +59,7 @@ __all__ = [
     "bucket_for",
     "classify",
     "content_key",
+    "parse_elastic",
     "parse_jsonl_line",
     "parse_mesh",
     "prepare",

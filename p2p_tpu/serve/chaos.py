@@ -41,6 +41,10 @@ snapshot machinery instead:
   ``journal.compact``: the snapshot is durably renamed but the WAL never
   rotates — the nastiest real crash window, which replay must fold
   idempotently (snapshot ∪ overlapping WAL, duplicates collapsed).
+- ``kill_during_resize`` — ARMS a kill inside the next elastic mesh
+  resize (ISSUE 19): the journaled ``resize`` record is durable but the
+  cutover never completes. The restart must resume on the *target*
+  topology the WAL recorded and replay every parked carry exactly-once.
 
 Plans are plain JSON (``{"by_batch": {"3": "transient"}, "by_request":
 {"r-07": "poison"}}``) so ``tools/loadgen.py`` can emit them next to a
@@ -82,9 +86,16 @@ KILL_AFTER_CACHE_INSERT = "kill_after_cache_insert"
 #: ``tmp-cap-*`` dir (the carry-spill GC discipline) and keep serving
 #: exactly-once; the ledger merely loses that one capture.
 KILL_DURING_CAPTURE = "kill_during_capture"
+#: ISSUE 19: die inside an elastic mesh resize — after the ``resize``
+#: journal record (old/new topology + parked carry ids) is durably
+#: fsync'd but before the cutover completes. The restart must read the
+#: WAL-recorded *target* topology, rebuild the mesh at the new dp, and
+#: resume every parked carry off its spill: exactly-once terminals,
+#: bitwise-identical ok outputs vs an uninterrupted run.
+KILL_DURING_RESIZE = "kill_during_resize"
 LIFECYCLE_KINDS = (SIGTERM, KILL_DURING_DRAIN, KILL_DURING_SNAPSHOT,
                    PREEMPT_THEN_KILL, KILL_AFTER_CACHE_INSERT,
-                   KILL_DURING_CAPTURE)
+                   KILL_DURING_CAPTURE, KILL_DURING_RESIZE)
 
 KINDS = ("transient", "poison", "fatal", "hang", "nan") + LIFECYCLE_KINDS
 
@@ -148,7 +159,7 @@ class FaultPlan:
         batch-boundary sync after a forced preemption)."""
         if kind not in (KILL_DURING_DRAIN, KILL_DURING_SNAPSHOT,
                         PREEMPT_THEN_KILL, KILL_AFTER_CACHE_INSERT,
-                        KILL_DURING_CAPTURE):
+                        KILL_DURING_CAPTURE, KILL_DURING_RESIZE):
             raise ValueError(f"not a kill kind: {kind!r}")
         self._armed_kills.add(kind)
 

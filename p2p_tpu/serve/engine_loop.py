@@ -157,6 +157,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import spans as obs_spans
 from ..obs.spans import span
 from . import chaos as chaos_mod
+from . import elastic as elastic_mod
 from . import faults as faults_mod
 from . import handoff as handoff_mod
 from . import lifecycle as lifecycle_mod
@@ -332,6 +333,7 @@ def serve_forever(
     semcache=None,
     costscope=None,
     prodscope=None,
+    elastic=None,
 ) -> Iterator[dict]:
     """Drain ``requests`` (Request/Cancel objects or JSONL-shaped dicts,
     sorted by ``arrival_ms``) through the queue → batcher → program-cache →
@@ -467,6 +469,27 @@ def serve_forever(
     ``prodscope=None`` (the default) changes nothing — records, journal
     and compiled programs byte-identical (the quality gate's
     ``profile_parity`` leg pins it).
+
+    ``elastic`` (None | ``True`` | ``'k=v,...'`` | ``serve.elastic.
+    ElasticConfig``) enables elastic mesh serving (ISSUE 19,
+    docs/SERVING.md "Elastic meshes"): an
+    :class:`~p2p_tpu.serve.elastic.ElasticController` watches queue
+    pressure through the degradation ladder's windowed detector run in
+    both directions (separate up/down sustain windows + a cooldown, so
+    the two can't flap) and the engine executes a journaled resize
+    protocol at batch boundaries — prewarm the target topology's
+    programs out-of-band, park in-flight phase-2 hand-offs via the
+    preemption spill path, journal a ``resize`` event (old/new dp +
+    parked ids), fsync, swap the mesh/runner-factory/bucket tables, and
+    resume the parked carries restaged onto the new shards. A restart
+    that lands between the durable ``resize`` record and cutover
+    completion (the ``kill_during_resize`` chaos window) resumes on the
+    WAL-recorded *target* topology. Elastic implies a mesh: with
+    ``mesh=None`` the engine starts at ``dp=1`` (bitwise-identical to
+    the mesh-less engine) and grows from there. ``elastic=None`` (the
+    default) changes nothing — records, journal bytes and compiled
+    programs byte-identical (the quality gate's ``elastic`` leg pins
+    it).
     """
     from ..engine.sampler import lane_select
     from ..utils import progress as progress_mod
@@ -475,7 +498,30 @@ def serve_forever(
     # are shaped by it. mesh=None keeps every value identical to the
     # pre-mesh engine (dp=1, the un-scaled bucket set, un-suffixed keys).
     mesh_spec = meshing_mod.as_spec(mesh)
+    elastic_ctl = None
+    if elastic is not None:
+        import jax as _jax
+
+        elastic_cfg = (
+            elastic_mod.ElasticConfig() if elastic is True
+            else elastic_mod.parse_elastic(elastic)
+            if isinstance(elastic, str) else elastic)
+        if mesh_spec is None:
+            # Elastic serving is mesh-native: start at dp=1 (bitwise-
+            # identical to the mesh-less engine) and let pressure grow it.
+            mesh_spec = meshing_mod.MeshSpec(dp=1)
+        if journal is not None and journal.replay_state.mesh_dp:
+            # Mid-resize restart: the WAL's last committed ``resize``
+            # record names the TARGET topology — come back on it (clamped
+            # to what this machine can host), not on the width the
+            # process was started with.
+            mesh_spec = meshing_mod.MeshSpec(dp=min(
+                int(journal.replay_state.mesh_dp),
+                elastic_mod.pow2_floor(len(_jax.devices()))))
+        elastic_ctl = elastic_mod.ElasticController(
+            elastic_cfg, mesh_spec.dp, len(_jax.devices()))
     dp = 1 if mesh_spec is None else mesh_spec.dp
+    dp0 = dp
     jmesh = None if mesh_spec is None else meshing_mod.build_mesh(mesh_spec)
     sizes = (BUCKET_SIZES if mesh_spec is None
              else meshing_mod.scaled_bucket_sizes(dp))
@@ -733,6 +779,18 @@ def serve_forever(
             "padded lanes dispatched per mesh device (bucket/dp each)",
             labels=("device",))
         _mesh_dev_ids = [str(d.id) for d in jmesh.devices.flat]
+    # Elastic families exist only under an active controller (the same
+    # disabled-mode registry discipline as slo/semcache). The mesh gauge
+    # above is already resize-safe: Gauge.set overwrites in place and the
+    # registry get-or-creates families, so a resize re-pointing the gauge
+    # (and adding per-device counter children for new devices) can never
+    # double-count.
+    m_resizes = None
+    if elastic_ctl is not None:
+        m_resizes = reg.counter(
+            "serve_resizes_total",
+            "elastic mesh resizes committed by direction",
+            labels=("direction",))
 
     def note_mesh_dispatch(bucket: int) -> None:
         """Per-device lane accounting for one successful dispatch: every
@@ -1150,8 +1208,15 @@ def serve_forever(
     def take_snapshot(trigger: str) -> dict:
         """One journal.compact pass + its bookkeeping (periodic + drain)."""
         nonlocal snapshots_taken
+        extra = {"degrade_level": degrade_level}
+        if elastic_ctl is not None:
+            # The elastic topology rides the snapshot (an optional key —
+            # elastic-off snapshots stay byte-identical) so a restart
+            # long after the resize's WAL segment rotated away still
+            # comes back on the committed width.
+            extra["mesh_dp"] = dp
         with span("serve.snapshot", trigger=trigger):
-            info = journal.compact(extra={"degrade_level": degrade_level},
+            info = journal.compact(extra=extra,
                                    on_durable=_snapshot_kill_hook)
         snapshots_taken += 1
         m_snapshots.labels(trigger=trigger).inc()
@@ -1841,8 +1906,12 @@ def serve_forever(
                         "parked (preempted) requests resumed into the "
                         "phase-2 batcher").inc()
             if flight is not None:
-                flight.wait(e.request_id, "preempt_wait", vnow,
-                            pool="phase2")
+                # A resize park is its own flight stage: the pause a
+                # cutover cost this request is `resize_wait`, not the
+                # scheduler's `preempt_wait`.
+                flight.wait(e.request_id,
+                            "resize_wait" if reason == "resize"
+                            else "preempt_wait", vnow, pool="phase2")
                 flight.event(e.request_id, "preempt_resumed", vnow,
                              reason=reason)
             batcher2.add(e, vnow)
@@ -1939,6 +2008,119 @@ def serve_forever(
 
     def _ck_phase2(e):
         return mkey(e.prepared.phase2_key)
+
+    # ------------------------------------------------------------------
+    # Elastic resize (serve.elastic, ISSUE 19): the controller decides in
+    # observe() (called with update_degradation each cycle); the protocol
+    # below executes at the batch-boundary fsync point. All of it is a
+    # no-op with elastic=None.
+    # ------------------------------------------------------------------
+
+    def _prewarm_resize(target_dp: int) -> dict:
+        """Compile-ahead on the target topology while the current mesh is
+        still the serving one: build the target mesh + runner factory and
+        warm a target-keyed program for every piece of live work (both
+        pools + parked), at the pools' effective caps AND the operator
+        caps (so a degradation restore right after the cutover stays
+        warm too). Out-of-band by construction — the virtual clock does
+        not advance, so no request's latency carries a resize build."""
+        t_spec = meshing_mod.MeshSpec(dp=target_dp)
+        t_jmesh = meshing_mod.build_mesh(t_spec)
+        t_sizes = meshing_mod.scaled_bucket_sizes(target_dp)
+        t_factory = runner_factory or default_runner_factory(
+            pipe, progress=progress, validate=validate_outputs,
+            heartbeat=watchdog_ms is not None, mesh=t_jmesh,
+            semcache=semcache)
+        caps1 = {batcher.max_batch // dp, max_batch}
+        caps2 = {batcher2.max_batch // dp, phase2_max_batch}
+        t0 = timer()
+        seen: set = set()
+        with span("serve.resize_prewarm", target_dp=target_dp):
+            for e in (list(batcher.entries()) + list(batcher2.entries())
+                      + list(parked)):
+                prep = e.prepared
+                if prep.gated and phase_pools:
+                    keyed = (
+                        [(meshing_mod.mesh_key(prep.phase1_key, t_spec), c)
+                         for c in caps1]
+                        + [(meshing_mod.mesh_key(prep.phase2_key, t_spec),
+                            c) for c in caps2])
+                else:
+                    keyed = [(meshing_mod.mesh_key(prep.compile_key,
+                                                   t_spec), c)
+                             for c in caps1]
+                for key, cap in keyed:
+                    bucket = cap * target_dp
+                    if (key, bucket) in seen:
+                        continue
+                    seen.add((key, bucket))
+                    cache.get((key, bucket),
+                              lambda k=key, b=bucket, ent=e: _build(
+                                  t_factory, k, b, [ent]))
+        return {"spec": t_spec, "jmesh": t_jmesh, "sizes": t_sizes,
+                "factory": t_factory,
+                "prewarm_ms": (timer() - t0) * 1000.0}
+
+    def maybe_resize() -> None:
+        """Execute a standing resize decision at this batch boundary:
+        prewarm → park in-flight phase-2 work (spill carries — the crash
+        copy) → journal the ``resize`` record → fsync → (chaos
+        ``kill_during_resize`` window) → swap the topology state →
+        resume the parked carries, restaged onto the new shards by the
+        new runners' ``stack_carries(mesh=)``. Phase-1 work still queued
+        has no device state to move — it just dispatches on the new
+        mesh's keys next cycle."""
+        nonlocal mesh_spec, dp, jmesh, sizes, make_runner, _mesh_dev_ids
+        if elastic_ctl is None or draining or fatal_reason[0] is not None:
+            return
+        target = elastic_ctl.pending_target
+        if target is None or target == dp:
+            return
+        direction = elastic_mod.UP if target > dp else elastic_mod.DOWN
+        pre = _prewarm_resize(target)
+        wall0 = timer()
+        with span("serve.resize", old_dp=dp, new_dp=target,
+                  direction=direction):
+            for e in batcher2.remove_if(lambda _e: True):
+                park(e, "resize")
+            parked_ids = [e.request_id for e in parked]
+            if journal is not None:
+                journal.event("resize", old_dp=dp, new_dp=target,
+                              direction=direction, parked=parked_ids,
+                              vnow_ms=round(vnow, 3))
+                journal.sync()
+            if chaos is not None and \
+                    chaos.take_kill(chaos_mod.KILL_DURING_RESIZE):
+                # Dies with the resize record durable but the cutover
+                # unfinished: the restart folds new_dp out of the WAL and
+                # comes back on the TARGET topology, resuming the parked
+                # carries off their spills exactly-once.
+                raise chaos_mod.SimulatedKill("chaos kill_during_resize")
+            mesh_spec = pre["spec"]
+            dp = target
+            jmesh = pre["jmesh"]
+            sizes = pre["sizes"]
+            make_runner = pre["factory"]
+            _mesh_dev_ids = [str(d.id) for d in jmesh.devices.flat]
+            batcher.bucket_sizes = sizes
+            batcher2.bucket_sizes = sizes
+            _apply_degrade_level()   # rescales both pools' caps by new dp
+            m_mesh_devices.set(dp)   # time-varying: the topology gauge
+            m_resizes.labels(direction=direction).inc()
+            if costscope is not None:
+                costscope.devices = max(1, dp)
+            if prodscope is not None:
+                prodscope.devices = max(1, dp)
+            resumed = len(parked)
+            resume_parked("resize")
+            pause_ms = (timer() - wall0) * 1000.0
+        entry = elastic_ctl.committed(
+            vnow, dp, prewarm_ms=pre["prewarm_ms"], pause_ms=pause_ms,
+            parked=len(parked_ids), resumed=resumed)
+        if flight is not None:
+            flight.loop_event("resize", vnow, old_dp=entry["old_dp"],
+                              new_dp=entry["new_dp"], direction=direction,
+                              parked=entry["parked"])
 
     def dispatch_phase1(batch: Batch) -> Iterator[dict]:
         nonlocal vnow, batch_index, retries_total
@@ -2606,6 +2788,17 @@ def serve_forever(
                              journal_write=(kind != "duplicate_id"),
                              arrival_ms=item.arrival_ms, reason=reason)
         update_degradation()
+        if elastic_ctl is not None and not draining:
+            # The elastic detector samples the same pressure signal the
+            # degradation ladder watches, every cycle. A standing shrink
+            # decision is deferred while premium work is live anywhere
+            # (premium traffic never waits on a cutover pause it didn't
+            # need); the cutover itself runs at the batch boundary below.
+            elastic_ctl.observe(
+                queue.outstanding, vnow,
+                premium_waiting=(slo is not None and any(
+                    t == scheduling_mod.TIERS[0]
+                    for t in tier_by_id.values())))
         # 2. Feed the batcher — at level 3, shedding what the threshold
         # cannot hold (lowest priority first, newest arrivals first).
         drained = queue.drain()
@@ -2678,6 +2871,9 @@ def serve_forever(
         if not batches and not batches2:
             if journal is not None:
                 journal.sync()  # going idle: everything admitted is durable
+            # An idle cycle is a batch boundary too: a lull-driven
+            # scale-down must not wait for the next dispatch to execute.
+            maybe_resize()
             # Draining: never wait on future arrivals or bucket age-outs —
             # flush everything now and exit once the pipeline is empty.
             events = [] if draining else [
@@ -2853,6 +3049,7 @@ def serve_forever(
             # record like a crashed hand-off and resumes in phase 2 off
             # the spill, exactly-once.
             raise chaos_mod.SimulatedKill("chaos preempt_then_kill")
+        maybe_resize()
         if journal is not None:
             if snapshot_every_ms is not None and not draining and \
                     vnow - last_snapshot_ms >= snapshot_every_ms:
@@ -2962,6 +3159,17 @@ def serve_forever(
             "max_batch_per_device": max_batch,
             "phase2_max_batch_per_device": phase2_max_batch,
         }
+        if elastic_ctl is not None:
+            # Under elastic serving the topology is a TIMELINE, not a
+            # shape: one epoch per committed width, starting at the
+            # width the process came up on. `dp` above reports the final
+            # epoch. Gated on the controller so elastic-off summaries
+            # stay byte-identical (disabled-mode parity).
+            summary["mesh"]["timeline"] = (
+                [{"vnow_ms": 0.0, "dp": dp0}]
+                + [{"vnow_ms": e["vnow_ms"], "dp": e["new_dp"]}
+                   for e in elastic_ctl.timeline])
+            summary["elastic"] = elastic_ctl.stats()
     if slo is not None:
         # Present only under an active SloConfig, so slo-less summaries
         # stay byte-identical (disabled-mode parity).

@@ -13,7 +13,8 @@ writes one JSON line per request-state transition —
 - ``cache``      — a semantic-cache L3 insert (content digest + result
   spill path), written before its leader's ``terminal`` so a crash in
   between still lets the restart serve the followers from the cache
-- ``event``      — loop-level transitions (degradation level changes)
+- ``event``      — loop-level transitions (degradation level changes,
+  elastic mesh ``resize`` commits — old/new topology + parked carry ids)
 
 — buffered in userspace and :meth:`Journal.sync`'d (flush + ``os.fsync``)
 at batch boundaries, so the fsync cost is paid once per dispatch, not once
@@ -113,6 +114,12 @@ class ReplayState:
     #: snapshot and any later journaled degrade/restore events) — a warm
     #: restart resumes it instead of re-learning the pressure from scratch.
     degrade_level: int = 0
+    #: ISSUE 19: the dp the previous incarnation last *committed to* via a
+    #: journaled ``resize`` event (0 = never resized / elastic off). A
+    #: restart that lands inside the resize window — the record is durable
+    #: but the cutover never finished — resumes on this TARGET topology,
+    #: not the one the process was started with.
+    mesh_dp: int = 0
     #: Snapshot fold facts: whether a snapshot seeded this state, whether a
     #: present-but-unreadable snapshot was ignored, and its sequence number.
     snapshot_loaded: bool = False
@@ -172,6 +179,7 @@ def _load_snapshot(spath: str):
             raise ValueError("bad cache")
         int(snap.get("seq", 0))
         int(snap.get("degrade_level", 0))
+        int(snap.get("mesh_dp", 0))
         int(snap.get("folded_records", 0))
         return snap, False
     except (OSError, ValueError, TypeError):
@@ -242,6 +250,7 @@ def replay(path: str, *, sweep: bool = True) -> ReplayState:
         state.snapshot_loaded = True
         state.snapshot_seq = int(snap.get("seq", 0))
         state.degrade_level = int(snap.get("degrade_level", 0))
+        state.mesh_dp = int(snap.get("mesh_dp", 0))
         state.folded_records = int(snap.get("folded_records", 0))
         for req in snap["pending"]:
             rid = req["request_id"]
@@ -303,11 +312,18 @@ def replay(path: str, *, sweep: bool = True) -> ReplayState:
                     state.cache_entries[key] = rec  # last insert wins
                 elif kind in (DISPATCHED, EVENT):
                     # Informational for replay — except the degradation
-                    # transitions, which the warm restart resumes.
+                    # transitions, which the warm restart resumes, and the
+                    # elastic ``resize`` commits, whose TARGET topology a
+                    # mid-resize restart must come back on.
                     if kind == EVENT and rec.get("kind") in ("degrade",
                                                              "restore"):
                         try:
                             state.degrade_level = int(rec.get("level"))
+                        except (TypeError, ValueError):
+                            pass
+                    elif kind == EVENT and rec.get("kind") == "resize":
+                        try:
+                            state.mesh_dp = int(rec.get("new_dp"))
                         except (TypeError, ValueError):
                             pass
                 else:
@@ -474,6 +490,11 @@ class Journal:
                 "degrade_level": int((extra or {}).get(
                     "degrade_level", state.degrade_level)),
                 "folded_records": state.folded_records}
+        # Optional (ISSUE 19): only elastic runs that have resized carry
+        # a topology, so pre-elastic snapshots stay byte-identical.
+        mesh_dp = int((extra or {}).get("mesh_dp", state.mesh_dp))
+        if mesh_dp:
+            snap["mesh_dp"] = mesh_dp
         # Cache index entries whose spill still exists (eviction deletes
         # the file but cannot rewrite history — the snapshot drops the
         # stale pointer instead). Key absent when empty, so cache-less

@@ -164,6 +164,25 @@ SERVE_PROFILE_KEYS = {
 }
 
 
+#: ISSUE 19: the serve block's `elastic` sub-record — the three-leg
+#: elastic drill (diurnal autonomy, fixed-topology parity, mid-resize
+#: kill). Frozen literal: cutover_pause_p95_ms is a benchwatch headline
+#: key (lower is better), and the kill leg's keys record that a crash
+#: between the durable resize record and cutover restarts on the WAL
+#: target topology with every parked carry resumed, exactly-once.
+SERVE_ELASTIC_KEYS = {
+    "n_requests", "resizes_up", "resizes_down",
+    "prewarm_ms", "cutover_pause_p95_ms",
+    "parked", "resumed", "dropped",
+    "parity_compared", "parity_max_abs", "kill",
+}
+
+SERVE_ELASTIC_KILL_KEYS = {
+    "killed", "restart_dp", "bitwise_compared",
+    "resumed_handoffs", "replay_skipped_corrupt",
+}
+
+
 def test_rehearsal_schema_unchanged_by_static_analysis_pr():
     """ISSUE 5 was a static-analysis PR, ISSUE 6 a serve-architecture PR,
     ISSUE 10 a mesh-serving PR, ISSUE 12 an SLO-scheduling PR and
@@ -172,8 +191,9 @@ def test_rehearsal_schema_unchanged_by_static_analysis_pr():
     sub-record — SERVE_PHASES_KEYS — ISSUE 10 its NESTED `mesh`
     sub-record — SERVE_MESH_KEYS — ISSUE 12 its NESTED `slo` sub-record
     — SERVE_SLO_KEYS — ISSUE 13 its NESTED `cache` sub-record —
-    SERVE_CACHE_KEYS — and ISSUE 18 its NESTED `profile` sub-record —
-    SERVE_PROFILE_KEYS). A future PR that grows the schema updates the
+    SERVE_CACHE_KEYS — ISSUE 18 its NESTED `profile` sub-record —
+    SERVE_PROFILE_KEYS — and ISSUE 19 its NESTED `elastic` sub-record —
+    SERVE_ELASTIC_KEYS). A future PR that grows the schema updates the
     frozen copies (and EXPECTED_KEYS, and bench._BLOCK_KEYS) in the same
     diff, deliberately."""
     assert EXPECTED_KEYS == {
@@ -735,6 +755,35 @@ def test_bench_rehearsal_green_and_complete():
     assert pb["ledger_bytes"] > 0
     assert pb["overhead_pct"] >= 0
     assert pb["drift_events"] >= 0
+    # Elastic-serving acceptance (ISSUE 19): the diurnal pressure trace
+    # really drove the engine up AND down the dp ladder with nothing
+    # dropped, every ok output matched the fixed-topology run within the
+    # documented vmap tolerance, target programs were prewarmed before
+    # cutover (a zero here means a post-cutover in-band compile), and
+    # the mid-resize kill restarted on the WAL target topology with the
+    # parked carries resumed off their spills — exactly the frozen keys
+    # the benchwatch headline (serve.elastic.cutover_pause_p95_ms)
+    # reads. The drill raises on any invariant violation, failing the
+    # rehearsal outright; these pins freeze the schema.
+    eb = doc["serve"]["elastic"]
+    assert set(eb) == SERVE_ELASTIC_KEYS
+    assert eb["resizes_up"] >= 2
+    assert eb["resizes_down"] >= 2
+    assert eb["dropped"] == 0
+    # The diurnal leg's trace is ungated, so its cutovers park nothing;
+    # parked-carry survival is the kill leg's job (resumed_handoffs).
+    assert eb["resumed"] == eb["parked"] >= 0
+    assert eb["prewarm_ms"] > 0
+    assert eb["cutover_pause_p95_ms"] >= 0
+    assert eb["parity_compared"] > 0
+    assert eb["parity_max_abs"] <= 1
+    kb = eb["kill"]
+    assert set(kb) == SERVE_ELASTIC_KILL_KEYS
+    assert kb["killed"] is True
+    assert kb["restart_dp"] == 2
+    assert kb["resumed_handoffs"] >= 1
+    assert kb["bitwise_compared"] >= 1
+    assert kb["replay_skipped_corrupt"] == 0
     mb = doc["serve"]["mesh"]
     assert set(mb) == SERVE_MESH_KEYS
     assert mb["devices"] >= 2            # the virtual mesh really spanned

@@ -1,0 +1,572 @@
+"""Elastic mesh serving (ISSUE 19): pressure-driven dp resize with
+exactly-once in-flight survival.
+
+Five layers of proof:
+
+1. **Config + decision** — ``--elastic`` parsing/validation and the
+   controller's hysteresis: separate up/down sustain windows, the dead
+   band that withdraws stale decisions, the cooldown, dp bounds clamped
+   to the machine, and the SLO rule (premium traffic defers *shrink*
+   only).
+2. **Protocol (fake runners, virtual clock)** — the engine executes a
+   decided resize at a batch boundary, reports the topology as a
+   timeline, and keeps the ``serve_mesh_devices`` gauge resize-safe
+   (one family, one sample, set-in-place — never double-counted).
+3. **Prewarm before cutover** — every program keyed for the target
+   topology is built while the OLD width is still the serving one
+   (observed through the topology gauge at build time): no in-band
+   compile after the swap.
+4. **Numerics** — a run that actually resizes dp=1→2→4 matches the
+   elastic-off engine at the repo's documented vmap tolerance (±1
+   uint8, p2p_tpu/serve/meshing.py).
+5. **Durability** — the ``resize`` WAL record folds to
+   ``ReplayState.mesh_dp`` (event and snapshot paths); a chaos
+   ``kill_during_resize`` mid-cutover restarts on the TARGET topology
+   and serves exactly-once; parked carries stay cancellable and
+   deadline-bound across the park/spill/resume round-trip.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from p2p_tpu.serve import (ElasticConfig, FaultPlan, Journal, Request,
+                           SimulatedKill, parse_elastic, serve_forever)
+from p2p_tpu.serve.chaos import KILL_DURING_RESIZE
+from p2p_tpu.serve.elastic import DOWN, UP, ElasticController, pow2_floor
+
+
+@pytest.fixture(scope="module")
+def tiny_pipe():
+    from p2p_tpu.analysis.contracts import tiny_pipeline
+
+    return tiny_pipeline()
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual 8-device CPU platform")
+    return jax.devices()
+
+
+class VirtualTimer:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt_s):
+        self.t += dt_s
+
+
+class FakeRunner:
+    def __init__(self, compile_key, bucket, timer, run_s=0.1, warm_s=0.5):
+        self.bucket = bucket
+        self.timer, self.run_s, self.warm_s = timer, run_s, warm_s
+
+    def warm(self, entries):
+        self.timer.advance(self.warm_s)
+
+    def __call__(self, entries, guidance):
+        self.timer.advance(self.run_s)
+        g = len(entries[0].request.prompts)
+        return np.zeros((self.bucket, g, 2, 2, 3), np.uint8)
+
+
+def _fake_serve(tiny_pipe, reqs, timer=None, **kw):
+    timer = timer or VirtualTimer()
+
+    def factory(compile_key, bucket):
+        return FakeRunner(compile_key, bucket, timer)
+
+    return list(serve_forever(tiny_pipe, reqs, runner_factory=factory,
+                              timer=timer, **kw))
+
+
+def _by_status(recs):
+    out = {}
+    for r in recs:
+        out.setdefault(r["status"], []).append(r)
+    return out
+
+
+def _req(rid, arrival=0.0, **kw):
+    return Request(request_id=rid, prompt="a cat", target="a dog",
+                   steps=4, arrival_ms=arrival, **kw)
+
+
+#: One quick deterministic resize 1→2: decision on the first pressured
+#: observation, then frozen (huge cooldown/down window) so a test sees
+#: exactly one cutover.
+_ONE_UP = ElasticConfig(up_depth=2, up_window_ms=0.0, down_depth=1,
+                        down_window_ms=1e6, cooldown_ms=1e6, max_dp=2)
+
+
+# ---------------------------------------------------------------------------
+# Config + parse
+# ---------------------------------------------------------------------------
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="min_dp"):
+        ElasticConfig(min_dp=3)
+    with pytest.raises(ValueError, match="max_dp"):
+        ElasticConfig(max_dp=3)
+    with pytest.raises(ValueError, match="max_dp"):
+        ElasticConfig(min_dp=4, max_dp=2)
+    # The dead band is the hysteresis: the thresholds may never touch.
+    with pytest.raises(ValueError, match="up_depth"):
+        ElasticConfig(up_depth=2, down_depth=2)
+
+
+def test_parse_elastic_values_and_errors():
+    assert parse_elastic("on") == ElasticConfig()
+    assert parse_elastic("default") == ElasticConfig()
+    assert parse_elastic("") == ElasticConfig()
+    cfg = parse_elastic("up_depth=4,down_window_ms=500,max_dp=4")
+    assert cfg == ElasticConfig(up_depth=4, down_window_ms=500.0, max_dp=4)
+    with pytest.raises(ValueError, match="k=v"):
+        parse_elastic("up_depth")
+    with pytest.raises(ValueError, match="unknown --elastic field"):
+        parse_elastic("dp=4")
+
+
+# ---------------------------------------------------------------------------
+# Controller: hysteresis, cooldown, bounds, SLO deferral
+# ---------------------------------------------------------------------------
+
+
+def _ctl(dp=1, ndev=8, **kw):
+    base = dict(up_depth=4, up_window_ms=100.0, down_depth=1,
+                down_window_ms=300.0, cooldown_ms=0.0)
+    base.update(kw)
+    return ElasticController(ElasticConfig(**base), dp, ndev)
+
+
+def test_up_decision_requires_sustained_pressure():
+    c = _ctl()
+    assert c.observe(10, 0.0) is None          # window just opened
+    assert c.observe(10, 99.0) is None
+    assert c.observe(10, 100.0) == 2           # sustained ⇒ grow
+    # A dip into the dead band restarts the window from scratch.
+    c = _ctl()
+    assert c.observe(10, 0.0) is None
+    assert c.observe(2, 50.0) is None          # dead band: timer re-arms
+    assert c.observe(10, 60.0) is None
+    assert c.observe(10, 159.0) is None        # only 99ms re-sustained
+    assert c.observe(10, 160.0) == 2
+
+
+def test_down_needs_longer_calm_and_respects_min_dp():
+    c = _ctl(dp=2, down_depth=2)               # lo = 4 at dp=2
+    assert c.observe(0, 0.0) is None
+    assert c.observe(0, 299.0) is None
+    assert c.observe(0, 300.0) == 1            # long calm ⇒ shrink
+    # dp already at min_dp: calm never decides below the floor.
+    c = _ctl(dp=1)
+    for t in (0.0, 300.0, 1000.0):
+        assert c.observe(0, t) is None
+
+
+def test_dead_band_withdraws_stale_decision():
+    c = _ctl()
+    c.observe(10, 0.0)
+    assert c.observe(10, 100.0) == 2           # decision standing
+    # Depth fell back inside the band before the cutover ran: the
+    # pressure that justified the resize is gone, the decision with it.
+    assert c.observe(2, 110.0) is None
+    assert c.pending_target is None
+
+
+def test_cooldown_spaces_resizes():
+    c = _ctl(cooldown_ms=400.0)
+    c.observe(10, 0.0)
+    assert c.observe(10, 100.0) == 2
+    c.committed(100.0, 2, prewarm_ms=1.0, pause_ms=1.0, parked=0,
+                resumed=0)
+    assert c.dp == 2
+    # Inside the cooldown nothing is even sampled into the windows.
+    assert c.observe(100, 499.0) is None
+    # After the cooldown the up window starts fresh — no credit for the
+    # pressure observed during the quiet period.
+    assert c.observe(100, 500.0) is None
+    assert c.observe(100, 600.0) == 4
+
+
+def test_dp_bounds_clamp_to_machine():
+    assert pow2_floor(1) == 1 and pow2_floor(3) == 2 and pow2_floor(8) == 8
+    # max_dp=0 resolves to the machine's power-of-two floor.
+    assert ElasticController(ElasticConfig(), 1, ndev=6).max_dp == 4
+    # An explicit max_dp still can't exceed the machine.
+    assert ElasticController(ElasticConfig(max_dp=8), 1, ndev=2).max_dp == 2
+    c = _ctl(dp=4, ndev=4)
+    for t in (0.0, 100.0, 1000.0):             # at the ceiling: never grow
+        assert c.observe(100, t) is None
+
+
+def test_premium_defers_shrink_not_growth():
+    c = _ctl(dp=2, down_depth=2, down_window_ms=100.0)
+    assert c.observe(0, 0.0, premium_waiting=True) is None
+    # The lull is real (the calm timer kept running) but the decision is
+    # held while premium work would eat the cutover pause.
+    assert c.observe(0, 100.0, premium_waiting=True) is None
+    assert c.deferred_slo == 1
+    assert c.observe(0, 101.0, premium_waiting=False) == 1
+    # Scale-ups are never deferred: more capacity helps premium.
+    c = _ctl()
+    c.observe(10, 0.0, premium_waiting=True)
+    assert c.observe(10, 100.0, premium_waiting=True) == 2
+
+
+def test_committed_folds_stats_and_timeline():
+    c = _ctl()
+    e = c.committed(50.0, 2, prewarm_ms=12.0, pause_ms=3.0, parked=2,
+                    resumed=2)
+    assert e == {"vnow_ms": 50.0, "old_dp": 1, "new_dp": 2,
+                 "direction": UP, "prewarm_ms": 12.0, "pause_ms": 3.0,
+                 "parked": 2, "resumed": 2}
+    c.committed(500.0, 1, prewarm_ms=5.0, pause_ms=9.0, parked=0,
+                resumed=0)
+    s = c.stats()
+    # Frozen keys: the summary `elastic` block and the bench
+    # `serve.elastic` sub-record both carry this shape.
+    assert s["resizes_up"] == 1 and s["resizes_down"] == 1
+    assert s["prewarm_ms"] == 17.0
+    assert s["cutover_pause_p95_ms"] == 9.0
+    assert s["parked"] == 2 and s["resumed"] == 2
+    assert [t["direction"] for t in s["timeline"]] == [UP, DOWN]
+
+
+# ---------------------------------------------------------------------------
+# Engine protocol (fake runners, virtual clock)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_resizes_and_reports_topology_timeline(tiny_pipe,
+                                                      eight_devices):
+    """A pressured trace crosses one cutover: the summary's mesh block
+    becomes a timeline (epoch per committed width), the elastic stats
+    land, and the gauges are resize-safe — ONE ``serve_mesh_devices``
+    sample holding the final width (Gauge.set overwrites in place; the
+    registry get-or-creates, so the re-registration after a resize can
+    never fork a second sample)."""
+    from p2p_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.registry().reset()
+    # Gated: the phase-2 batcher holds carries at the cutover boundary,
+    # so the resize actually parks/resumes (and prewarms) something.
+    reqs = [_req(f"r{i}", float(i), gate=0.5) for i in range(6)]
+    recs = _fake_serve(tiny_pipe, reqs, max_batch=2, max_wait_ms=20.0,
+                       elastic=_ONE_UP)
+    by = _by_status(recs)
+    assert len(by["ok"]) == 6
+    summary = by["summary"][0]
+    assert summary["mesh"]["dp"] == 2
+    tl = summary["mesh"]["timeline"]
+    assert tl[0] == {"vnow_ms": 0.0, "dp": 1} and tl[-1]["dp"] == 2
+    st = summary["elastic"]
+    assert st["resizes_up"] == 1 and st["resizes_down"] == 0
+    assert st["parked"] >= 1 and st["resumed"] == st["parked"]
+    assert st["prewarm_ms"] > 0                # compile-ahead really ran
+    snap = obs_metrics.registry().snapshot()
+    (g,) = snap["serve_mesh_devices"]["samples"]
+    assert g["value"] == 2.0                   # time-varying, final epoch
+    (r,) = snap["serve_resizes_total"]["samples"]
+    assert r["labels"] == {"direction": UP} and r["value"] == 1.0
+    # reset() zeroes in place — the family survives, the count restarts
+    # (the between-runs snapshot semantics a resize must not break).
+    obs_metrics.registry().reset()
+    snap2 = obs_metrics.registry().snapshot()
+    (g2,) = snap2["serve_mesh_devices"]["samples"]
+    assert g2["value"] == 0.0
+
+
+def test_elastic_off_carries_no_artifacts(tiny_pipe, tmp_path):
+    """Disabled-mode parity, the record/journal half: without
+    ``elastic`` there is no mesh/elastic summary block and no ``resize``
+    journal record (the gate's ``elastic`` leg pins the full byte
+    compare)."""
+    wal = str(tmp_path / "plain.wal")
+    j = Journal(wal)
+    recs = _fake_serve(tiny_pipe, [_req("r0")], max_batch=2,
+                       max_wait_ms=5.0, journal=j)
+    j.close()
+    assert "mesh" not in recs[-1] and "elastic" not in recs[-1]
+    kinds = {json.loads(l).get("kind") for l in open(wal) if l.strip()}
+    assert "resize" not in kinds
+    assert Journal(wal).replay_state.mesh_dp == 0
+
+
+# ---------------------------------------------------------------------------
+# Prewarm before cutover
+# ---------------------------------------------------------------------------
+
+
+def _key_dp(compile_key):
+    """The dp a mesh-suffixed compile key is shaped for (None off-mesh)."""
+    tail = compile_key[-1] if compile_key else None
+    if isinstance(tail, tuple) and len(tail) == 3 and tail[0] == "mesh":
+        return int(tail[2])
+    return None
+
+
+@pytest.mark.slow
+def test_prewarm_builds_target_programs_before_cutover(tiny_pipe,
+                                                       eight_devices):
+    """No in-band compile after the swap: every dp=2-keyed program is
+    built while the topology gauge still reads dp=1 — i.e. during the
+    out-of-band prewarm, with the old mesh still the serving one. Real
+    runners: the factory wrapper only observes, the numerics are the
+    engine's own. Slow (real multi-width compiles) — the default-on
+    quality-gate `elastic` leg and the bench `serve.elastic` drill pin
+    prewarm-before-cutover on every round too."""
+    from p2p_tpu.obs import metrics as obs_metrics
+    from p2p_tpu.serve.meshing import MeshSpec, build_mesh
+    from p2p_tpu.serve.programs import default_runner_factory
+
+    obs_metrics.registry().reset()
+    timer = VirtualTimer()
+    builds = []                                # (key dp, gauge dp at build)
+    inner = {}
+
+    def factory(compile_key, bucket):
+        dp = _key_dp(compile_key) or 1
+        if dp not in inner:
+            inner[dp] = default_runner_factory(
+                tiny_pipe, mesh=build_mesh(MeshSpec(dp=dp)))
+        gauge = obs_metrics.registry().get("serve_mesh_devices")
+        builds.append((dp, int(gauge.value) if gauge else None))
+        real = inner[dp](compile_key, bucket)
+
+        class Wrapped:
+            def __init__(self):
+                self.bucket = bucket
+
+            def warm(self, entries):
+                real.warm(entries)
+
+            def __call__(self, entries, guidance):
+                timer.advance(0.06)            # virtual service pressure
+                return real(entries, guidance)
+
+        return Wrapped()
+
+    # Gated: carries live in the phase-2 batcher when the cutover runs,
+    # so the prewarm has target keys to build and the post-cutover
+    # phase-2 dispatch exercises them.
+    reqs = [Request(request_id=f"p{i}", prompt="a cat riding a bike",
+                    target="a dog riding a bike", mode="replace", steps=3,
+                    seed=40 + i, gate=0.5, arrival_ms=float(i))
+            for i in range(6)]
+    recs = list(serve_forever(tiny_pipe, reqs, max_batch=2,
+                              max_wait_ms=20.0, timer=timer,
+                              runner_factory=factory, elastic=_ONE_UP))
+    by = _by_status(recs)
+    assert len(by["ok"]) == 6
+    assert by["summary"][0]["elastic"]["resizes_up"] == 1
+    dp2 = [g for d, g in builds if d == 2]
+    assert dp2, "the resize never compiled a target-topology program"
+    assert all(g == 1 for g in dp2), \
+        f"dp=2 program built AFTER cutover (gauge read {dp2}) — " \
+        f"an in-band compile the prewarm contract forbids"
+
+
+# ---------------------------------------------------------------------------
+# Numerics: resize parity at the documented vmap tolerance
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_resize_through_dp_1_2_4_matches_fixed_run(tiny_pipe,
+                                                   eight_devices):
+    """A run that climbs 1→2→4 mid-trace serves every output within the
+    repo's vmap tolerance (±1 uint8) of the elastic-off engine — the
+    cutovers moved topology, not numerics. Two gated waves: the first
+    forces 1→2 and finishes phase 2 on the widened mesh; the second
+    lands after that cutover and forces 2→4. Slow (real compiles at
+    three widths) — the quality-gate `elastic` leg byte-compares a
+    192-request diurnal trace against the fixed engine every round."""
+    def wave(base, at):
+        return [Request(request_id=f"n{base + i}",
+                        prompt="a cat riding a bike",
+                        target="a dog riding a bike", mode="replace",
+                        steps=3, seed=60 + base + i, gate=0.5,
+                        arrival_ms=at + float(i)) for i in range(6)]
+
+    reqs = wave(0, 0.0) + wave(6, 900.0)
+    base = {r["request_id"]: r["images"]
+            for r in serve_forever(tiny_pipe, list(reqs), max_batch=2,
+                                   max_wait_ms=20.0, timer=lambda: 0.0)
+            if r["status"] == "ok"}
+
+    timer = VirtualTimer()
+    from p2p_tpu.serve.meshing import MeshSpec, build_mesh
+    from p2p_tpu.serve.programs import default_runner_factory
+
+    inner = {}
+
+    def factory(compile_key, bucket):
+        dp = _key_dp(compile_key) or 1
+        if dp not in inner:
+            inner[dp] = default_runner_factory(
+                tiny_pipe, mesh=build_mesh(MeshSpec(dp=dp)))
+        real = inner[dp](compile_key, bucket)
+
+        class Wrapped:
+            def __init__(self):
+                self.bucket = bucket
+
+            def warm(self, entries):
+                real.warm(entries)
+
+            def __call__(self, entries, guidance):
+                timer.advance(0.06)
+                return real(entries, guidance)
+
+        return Wrapped()
+
+    cfg = ElasticConfig(up_depth=2, up_window_ms=0.0, down_depth=1,
+                        down_window_ms=1e6, cooldown_ms=0.0, max_dp=4)
+    recs = list(serve_forever(tiny_pipe, list(reqs), max_batch=2,
+                              max_wait_ms=20.0, timer=timer,
+                              runner_factory=factory, elastic=cfg))
+    by = _by_status(recs)
+    assert len(by["ok"]) == 12
+    summary = by["summary"][0]
+    assert summary["elastic"]["resizes_up"] >= 2   # reached dp=4
+    assert summary["mesh"]["dp"] == 4
+    for r in by["ok"]:
+        d = np.abs(r["images"].astype(np.int16)
+                   - base[r["request_id"]].astype(np.int16))
+        assert d.max() <= 1, \
+            f"{r['request_id']}: resize drift {d.max()} > vmap tolerance"
+
+
+# ---------------------------------------------------------------------------
+# Durability: WAL fold, mid-resize crash, parked-carry cancel/deadline
+# ---------------------------------------------------------------------------
+
+
+def test_journal_folds_resize_target_from_event_and_snapshot(tmp_path):
+    """``ReplayState.mesh_dp`` names the WAL's last committed target
+    topology — folded from the ``resize`` EVENT line, carried through
+    compaction via the snapshot's optional ``mesh_dp`` key."""
+    wal = str(tmp_path / "fold.wal")
+    j = Journal(wal)
+    j.event("resize", old_dp=1, new_dp=2, direction=UP, parked=[],
+            vnow_ms=10.0)
+    j.event("resize", old_dp=2, new_dp=4, direction=UP, parked=[],
+            vnow_ms=20.0)
+    j.sync()
+    j._f.close()                               # simulated death: no close()
+    j2 = Journal(wal)
+    assert j2.replay_state.mesh_dp == 4        # last record wins
+    j2.compact(extra={"mesh_dp": 4})
+    j2.close()
+    assert Journal(wal).replay_state.mesh_dp == 4  # snapshot path
+
+
+def test_kill_during_resize_restarts_on_target_topology(tiny_pipe,
+                                                        eight_devices,
+                                                        tmp_path):
+    """The mid-resize crash window: the process dies with the ``resize``
+    record durable but the cutover unfinished. The restart must come
+    back ON THE TARGET width (WAL fold, not the startup width), resume
+    the parked carries off their spills, and resolve every request
+    exactly once."""
+    wal = str(tmp_path / "resize-kill.wal")
+    reqs = [_req(f"g{i}", float(i), gate=0.5) for i in range(6)]
+
+    j1 = Journal(wal)
+    gen = serve_forever(
+        tiny_pipe, list(reqs), journal=j1, max_batch=2, max_wait_ms=20.0,
+        runner_factory=lambda k, b: FakeRunner(k, b, timer1),
+        timer=(timer1 := VirtualTimer()), elastic=_ONE_UP,
+        chaos=FaultPlan(by_request={"g0": KILL_DURING_RESIZE}))
+    first = []
+    with pytest.raises(SimulatedKill):
+        for rec in gen:
+            first.append(rec)
+    j1._f.close()                              # simulated process death
+
+    wal_recs = [json.loads(l) for l in open(wal) if l.strip()]
+    (rz,) = [r for r in wal_recs if r.get("kind") == "resize"]
+    assert rz["old_dp"] == 1 and rz["new_dp"] == 2
+    assert rz["direction"] == UP and rz["parked"]
+
+    j2 = Journal(wal)
+    assert j2.replay_state.mesh_dp == 2        # the WAL names the target
+    timer2 = VirtualTimer()
+    second = list(serve_forever(
+        tiny_pipe, list(reqs), journal=j2, max_batch=2, max_wait_ms=20.0,
+        runner_factory=lambda k, b: FakeRunner(k, b, timer2),
+        timer=timer2, elastic=_ONE_UP))
+    j2.close()
+    summary = second[-1]
+    # Restart epoch 0 is ALREADY the target topology.
+    assert summary["mesh"]["timeline"][0] == {"vnow_ms": 0.0, "dp": 2}
+    # Fake carries fail the spill template validation, so the replay
+    # takes its documented fallback — full re-run, at-least-once compute
+    # but exactly-once STATE (the real-spill resume is pinned by the
+    # chaos drill's elastic leg and test_serve_mesh's crash test).
+    assert summary["phases"]["handoffs"] == 6
+    done = [r["request_id"] for r in first + second
+            if r.get("status") == "ok"]
+    assert sorted(done) == [f"g{i}" for i in range(6)]  # exactly once
+
+
+def test_parked_carry_stays_cancellable_and_deadline_bound(
+        tiny_pipe, eight_devices, tmp_path):
+    """The resize parks in-flight hand-offs through the spill path; the
+    park/spill/resume round-trip must not launder a pending cancel or a
+    passed deadline into a completed request — both resolve at the
+    post-resize dispatch, exactly once, spills GC'd. Survivors carry the
+    cutover as the flight's ``resize_wait`` stage."""
+    from p2p_tpu.obs.flight import FlightTracer
+
+    wal = str(tmp_path / "resize-cancel.wal")
+    j = Journal(wal)
+    flight = FlightTracer()
+    # g1's deadline (180ms from arrival 1.0) passes while its carry sits
+    # parked/batched; the cancel for g0 arrives (anchored on the late
+    # request) after phase 1 finished but before the phase-2 dispatch.
+    reqs = ([_req("g0", 0.0, gate=0.5), _req("g1", 1.0, gate=0.5,
+                                             deadline_ms=180.0)]
+            + [_req(f"g{i}", float(i), gate=0.5) for i in range(2, 6)]
+            + [_req("late", 150.0), {"cancel": "g0"}])
+    timer = VirtualTimer()
+    recs = list(serve_forever(
+        tiny_pipe, reqs, journal=j, flight=flight, max_batch=2,
+        max_wait_ms=200.0, phase2_max_batch=4, timer=timer,
+        runner_factory=lambda k, b: FakeRunner(k, b, timer),
+        elastic=_ONE_UP))
+    j.close()
+    by = _by_status(recs)
+    assert [r["request_id"] for r in by.get("cancelled", [])] == ["g0"]
+    assert [r["request_id"] for r in by.get("expired", [])] == ["g1"]
+    assert sorted(r["request_id"] for r in by["ok"]) == \
+        ["g2", "g3", "g4", "g5", "late"]
+    st = recs[-1]["elastic"]
+    assert st["resizes_up"] == 1 and st["parked"] >= 2
+    # Every parked entry crossed the cutover as `resize_wait` (not the
+    # scheduler's preempt_wait) — cancelled/expired ones included: the
+    # stage is attributed at resume, the terminal lands at dispatch.
+    stages = {(s["stage"], s.get("pool"))
+              for fl in flight.records for s in fl["segments"]}
+    assert ("resize_wait", "phase2") in stages
+    # Exactly-once state, no orphan spills.
+    from p2p_tpu.serve import replay
+
+    state = replay(wal)
+    assert state.pending == []
+    assert state.terminal["g0"] == "cancelled"
+    assert state.terminal["g1"] == "expired"
+    carry_dir = wal + ".carry"
+    leftovers = (os.listdir(carry_dir) if os.path.isdir(carry_dir) else [])
+    assert leftovers == []

@@ -209,6 +209,80 @@ def test_zipf_popularity_mode_discipline_and_prefix_stability():
         lg.generate_trace(4, zipf_s=1.1, zipf_universe=0)
 
 
+def test_diurnal_modulates_rate_without_perturbing_the_stream():
+    """ISSUE 19 satellite pin: --diurnal divides each drawn poisson gap by
+    a deterministic sinusoidal day-curve multiplier, so the base RNG
+    stream is consumed identically — everything except arrival_ms is
+    byte-identical to the flat trace, and switching the mode off restores
+    the flat trace byte-for-byte (the docstring's claim)."""
+    import itertools
+
+    lg = _loadgen()
+    assert lg.parse_diurnal("on") == lg.parse_diurnal("") == \
+        lg.parse_diurnal("default") == \
+        {"period_ms": 4000.0, "low": 0.25, "high": 4.0}
+    assert lg.parse_diurnal("period_ms=2000,high=8") == \
+        {"period_ms": 2000.0, "low": 0.25, "high": 8.0}
+    flat = lg.generate_trace(64, mode="poisson", rate_per_s=40.0, seed=5,
+                             steps=4)
+    day = lg.generate_trace(64, mode="poisson", rate_per_s=40.0, seed=5,
+                            steps=4, diurnal=lg.parse_diurnal("on"))
+    assert day == lg.generate_trace(64, mode="poisson", rate_per_s=40.0,
+                                    seed=5, steps=4,
+                                    diurnal=lg.parse_diurnal("on"))
+    # diurnal=None IS the flat trace (off restores bytes), and with the
+    # mode on only arrival_ms may differ.
+    assert flat == lg.generate_trace(64, mode="poisson", rate_per_s=40.0,
+                                     seed=5, steps=4, diurnal=None)
+    for f, d in zip(flat, day):
+        assert {k: v for k, v in d.items() if k != "arrival_ms"} == \
+            {k: v for k, v in f.items() if k != "arrival_ms"}
+    # The modulation is real and bounded: each diurnal gap is the flat
+    # gap divided by the curve value, which lives in [low, high] — and a
+    # trace spanning a full 4 s virtual day visits both ends of it.
+    fgaps = [b["arrival_ms"] - a["arrival_ms"]
+             for a, b in zip(flat, flat[1:])]
+    dgaps = [b["arrival_ms"] - a["arrival_ms"] for a, b in zip(day, day[1:])]
+    mults = [f / d for f, d in zip(fgaps, dgaps) if d > 0]
+    assert all(0.25 - 1e-9 <= m <= 4.0 + 1e-9 for m in mults)
+    assert max(mults) / min(mults) > 4.0
+    # The phase offset rides its own derived stream: a different seed
+    # peaks at a different time of "day" (different multiplier at t=0).
+    flat9 = lg.generate_trace(64, mode="poisson", rate_per_s=40.0, seed=9,
+                              steps=4)
+    day9 = lg.generate_trace(64, mode="poisson", rate_per_s=40.0, seed=9,
+                             steps=4, diurnal=lg.parse_diurnal("on"))
+    m5 = fgaps[0] / dgaps[0]
+    m9 = (flat9[1]["arrival_ms"] - flat9[0]["arrival_ms"]) / \
+        (day9[1]["arrival_ms"] - day9[0]["arrival_ms"])
+    assert abs(m5 - m9) > 1e-6
+    # Own-stream discipline: diurnal never perturbs the mix draws.
+    gmix = lg.parse_gate_mix("0.5:1,off:1")
+    gated = lg.generate_trace(32, seed=5, steps=4, gate_mix=gmix)
+    both = lg.generate_trace(32, seed=5, steps=4, gate_mix=gmix,
+                             diurnal=lg.parse_diurnal("on"))
+    assert [m.get("gate") for m in both] == [g.get("gate") for g in gated]
+    # The streaming form rides the same per-request draw order (the
+    # seed-stable prefix contract).
+    assert list(itertools.islice(
+        lg.generate_stream(None, mode="poisson", rate_per_s=40.0, seed=5,
+                           steps=4, diurnal=lg.parse_diurnal("on")),
+        32)) == day[:32]
+    # Validation: burst mode has no rate to modulate; parse errors name
+    # the offending field.
+    with pytest.raises(ValueError, match="no rate to modulate"):
+        lg.generate_trace(4, mode="burst", steps=4,
+                          diurnal=lg.parse_diurnal("on"))
+    with pytest.raises(ValueError, match="expects 'on' or 'k=v"):
+        lg.parse_diurnal("fast")
+    with pytest.raises(ValueError, match="unknown --diurnal field"):
+        lg.parse_diurnal("speed=2")
+    with pytest.raises(ValueError, match="period_ms must be positive"):
+        lg.parse_diurnal("period_ms=0")
+    with pytest.raises(ValueError, match="0 < low <= high"):
+        lg.parse_diurnal("low=2,high=1")
+
+
 def test_cross_tool_seed_stability_pins():
     """ISSUE 13 bugfix satellite: the PR-8 per-request draw-order change
     silently shifted every tool's seeded workload once — this pin makes

@@ -65,6 +65,11 @@ HEADLINE_KEYS: Tuple[Tuple[str, str, str], ...] = (
     ("serve.mesh.scaling_ratio", "x", "higher"),
     ("serve.slo.premium_p99_ratio", "x", "lower"),
     ("serve.cache.amplification", "x", "higher"),
+    # ISSUE 19: how long in-flight phase-2 work sat parked across an
+    # elastic dp cutover (p95 over the drill's resizes, virtual-clock
+    # ms — byte-stable across hosts). Missing in pre-elastic rounds →
+    # n/a per the contract; direction: lower is better.
+    ("serve.elastic.cutover_pause_p95_ms", "ms", "lower"),
     ("obs.overhead_pct", "%", "lower"),
     # ISSUE 18: what sampled in-engine device profiling costs the serve
     # rehearsal — capture wall time over non-capture serve wall time as

@@ -962,6 +962,281 @@ def cache_insert_kill_drill(pipe, journal_path, *, steps=3) -> dict:
     }
 
 
+def _elastic_real_factory(pipe, timer, service_ms):
+    """Mesh-aware, virtual-clock real-runner factory for the elastic
+    drills: builds the engine's *real* runner for whatever topology the
+    (mesh-tagged) compile key names, charging ``service_ms`` of injected
+    virtual time per dispatch — so the diurnal pressure swings are
+    deterministic AND the outputs are real pipeline numerics the parity
+    check can bite on. One default factory (weight replication included)
+    is built lazily per distinct dp and shared by every runner at that
+    width."""
+    from p2p_tpu.serve.meshing import MESH_KEY_TAG, MeshSpec, build_mesh
+    from p2p_tpu.serve.programs import default_runner_factory
+
+    inner_by_dp: dict = {}
+
+    def inner_factory(dp):
+        if dp not in inner_by_dp:
+            mesh = build_mesh(MeshSpec(dp=dp)) if dp else None
+            inner_by_dp[dp] = default_runner_factory(pipe, mesh=mesh)
+        return inner_by_dp[dp]
+
+    def make(compile_key, bucket):
+        dp = 0  # untagged key = the mesh-less engine (the fixed baseline)
+        if (compile_key and isinstance(compile_key[-1], tuple)
+                and len(compile_key[-1]) == 3
+                and compile_key[-1][0] == MESH_KEY_TAG):
+            dp = int(compile_key[-1][2])
+        inner = inner_factory(dp)(compile_key, bucket)
+
+        class Wrapped:
+            def __init__(self):
+                self.bucket = bucket
+
+            def warm(self, entries):
+                # Warm time is charged to the virtual clock too, so the
+                # engine's prewarm_ms bookkeeping measures something
+                # deterministic (the real compile happens out-of-band of
+                # the virtual service timeline either way).
+                timer.advance(2 * service_ms / 1000.0)
+                return inner.warm(entries)
+
+            def __call__(self, entries, guidance):
+                timer.advance(service_ms / 1000.0)
+                return inner(entries, guidance)
+
+        return Wrapped()
+
+    return make
+
+
+def elastic_resize_drill(pipe, journal_path=None, *, n=192, seed=19,
+                         steps=3, service_ms=60.0, max_batch=2) -> dict:
+    """The elastic serving drill (ISSUE 19), three legs:
+
+    1. **Diurnal autonomy** — a seeded loadgen ``--diurnal`` trace (peaks
+       well above dp=1 capacity, troughs well below) served with
+       ``elastic`` on, real runners on a deterministic virtual clock: the
+       engine must resize dp up AND down at least twice each, drop
+       nothing (zero rejected/shed), and resolve every request
+       exactly-once.
+    2. **Fixed-topology parity** — the same trace through the mesh-less
+       fixed engine: every ``ok`` output must match within the repo's
+       documented vmap tolerance (±1 uint8 step, serve/meshing.py) — a
+       resize may change *where* a lane runs, never what it computes
+       beyond that bound.
+    3. **Mid-resize crash** — a gated burst with chaos
+       ``kill_during_resize``: the process dies after the ``resize``
+       record is durable but before cutover. The restart must come back
+       on the WAL-recorded *target* topology, resume every parked carry
+       off its spill, and the union of both runs must be exactly-once
+       with ok-outputs bitwise-identical to the uninterrupted elastic
+       run.
+
+    Returns the ``serve.elastic`` bench sub-record (frozen keys pinned in
+    tests/test_bench_rehearsal.py)."""
+    import importlib.util
+
+    import jax
+    import numpy as np
+
+    from p2p_tpu.serve import (ElasticConfig, FaultPlan, Journal, Request,
+                               SimulatedKill, serve_forever)
+    from p2p_tpu.serve.chaos import KILL_DURING_RESIZE
+
+    if len(jax.devices()) < 4:
+        raise DrillFailure(
+            f"elastic_resize_drill needs >= 4 devices for a 1<->2<->4 dp "
+            f"swing; this process has {len(jax.devices())} (virtual CPU "
+            f"meshes: --xla_force_host_platform_device_count)")
+
+    spec = importlib.util.spec_from_file_location(
+        "p2p_loadgen", os.path.join(_REPO, "tools", "loadgen.py"))
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+
+    # Offered load swings around dp=1 capacity (max_batch lanes per
+    # service_ms): peaks at 3.5x justify growing toward dp=4, troughs at
+    # 0.05x let the widened mesh drain and go calm so it shrinks back —
+    # several full day-cycles per trace, so the >=2-each resize floor is
+    # structural, not lucky. The time-averaged offered rate sits between
+    # dp=1 and dp=2 capacity: a frozen dp=1 engine lags the whole trace,
+    # the elastic one keeps catching up (which is the point).
+    capacity = max_batch * 1000.0 / service_ms
+    trace = loadgen.generate_trace(
+        n, mode="poisson", rate_per_s=capacity, seed=seed,
+        steps=steps, diurnal={"period_ms": 1200.0, "low": 0.05,
+                              "high": 3.5})
+    cfg = ElasticConfig(up_depth=3, up_window_ms=40.0, down_depth=2,
+                        down_window_ms=150.0, cooldown_ms=100.0, max_dp=4)
+    kw = dict(max_batch=max_batch, max_wait_ms=20.0, queue_cap=4 * n,
+              phase2_max_batch=max_batch)
+
+    def to_reqs(t):
+        return [Request.from_dict(d) for d in t]
+
+    def run(elastic):
+        timer = _VirtualTimer()
+        return list(serve_forever(
+            pipe, to_reqs(trace), timer=timer,
+            runner_factory=_elastic_real_factory(pipe, timer, service_ms),
+            prewarm=_prewarm_reps(pipe, trace), elastic=elastic, **kw))
+
+    recs = run(cfg)
+    by_id = check_exactly_once(trace, recs, "elastic diurnal run")
+    dropped = sum(1 for r in _terminal_records(recs)
+                  if r["status"] in ("rejected", "shed"))
+    if dropped:
+        raise DrillFailure(f"elastic diurnal run dropped {dropped} "
+                           f"request(s) — resizing must add capacity, "
+                           f"never shed work")
+    summary = recs[-1]
+    stats = summary.get("elastic", {})
+    if stats.get("resizes_up", 0) < 2 or stats.get("resizes_down", 0) < 2:
+        raise DrillFailure(
+            f"elastic diurnal run resized up {stats.get('resizes_up')}x / "
+            f"down {stats.get('resizes_down')}x — the drill needs >= 2 "
+            f"each (timeline: {stats.get('timeline')})")
+    if stats.get("prewarm_ms", 0) <= 0:
+        raise DrillFailure("resizes committed with zero prewarm time — "
+                           "cutovers must compile-ahead, never in-band")
+
+    # Leg 2: fixed-topology parity at the documented vmap tolerance.
+    fixed = run(None)
+    fixed_by_id = check_exactly_once(trace, fixed, "fixed-topology run")
+    max_abs = 0
+    compared = 0
+    for rid, rec in by_id.items():
+        if rec["status"] != "ok":
+            continue
+        ref = fixed_by_id.get(rid)
+        if ref is None or ref["status"] != "ok":
+            raise DrillFailure(f"request {rid!r} is ok under elastic but "
+                               f"not in the fixed-topology run")
+        delta = int(np.max(np.abs(
+            np.asarray(rec["images"], np.int16)
+            - np.asarray(ref["images"], np.int16)))) if np.asarray(
+                rec["images"]).size else 0
+        max_abs = max(max_abs, delta)
+        compared += 1
+    if compared == 0:
+        raise DrillFailure("elastic parity compared zero ok outputs")
+    if max_abs > 1:
+        raise DrillFailure(
+            f"elastic vs fixed-topology outputs differ by {max_abs} uint8 "
+            f"steps (documented vmap tolerance: 1) — a resize changed "
+            f"the numerics")
+
+    # Leg 3: kill_during_resize — die between the durable resize record
+    # and cutover; restart on the WAL target topology, exactly-once.
+    kill = {}
+    if journal_path is not None:
+        prompts = ("a cat riding a bike", "a dog riding a bike")
+        ktrace = [{"request_id": f"ez-{i}", "prompt": prompts[0],
+                   "target": prompts[1], "mode": "replace", "steps": steps,
+                   "seed": 40 + i, "gate": 0.5, "arrival_ms": float(i)}
+                  for i in range(6)]
+        # max_dp=2 + a long cooldown pin the whole post-resize tail to
+        # dp=2 in BOTH the uninterrupted and the crashed+restarted run,
+        # so the union comparison can demand bitwise equality.
+        kcfg = ElasticConfig(up_depth=2, up_window_ms=0.0, down_depth=1,
+                             down_window_ms=1e6, cooldown_ms=1e6, max_dp=2)
+
+        def krun(elastic, journal=None, chaos=None, sink=None):
+            timer = _VirtualTimer()
+            gen = serve_forever(
+                pipe, to_reqs(ktrace), timer=timer,
+                runner_factory=_elastic_real_factory(pipe, timer,
+                                                     service_ms),
+                prewarm=_prewarm_reps(pipe, ktrace), elastic=elastic,
+                journal=journal, chaos=chaos, **kw)
+            if sink is None:
+                return list(gen)
+            for _ in first_iter(gen, sink):
+                pass
+            return sink
+
+        kclean = krun(kcfg)
+        kclean_by_id = check_exactly_once(ktrace, kclean,
+                                          "uninterrupted elastic run")
+        if os.path.exists(journal_path):
+            os.remove(journal_path)
+        plan = FaultPlan(by_request={"ez-0": KILL_DURING_RESIZE})
+        journal = Journal(journal_path)
+        first: list = []
+        killed = False
+        try:
+            krun(kcfg, journal=journal, chaos=plan, sink=first)
+        except SimulatedKill:
+            killed = True
+            journal._f.close()   # simulated death: no clean close
+        if not killed:
+            raise DrillFailure("kill_during_resize never fired — no "
+                               "resize ran after the kill was armed")
+
+        journal2 = Journal(journal_path)
+        if journal2.replay_state.mesh_dp != 2:
+            raise DrillFailure(
+                f"the WAL's resize record did not fold: replay mesh_dp = "
+                f"{journal2.replay_state.mesh_dp}, expected the target "
+                f"topology 2")
+        second = krun(kcfg, journal=journal2)
+        journal2.close()
+        restart_timeline = second[-1].get("mesh", {}).get("timeline", [])
+        if not restart_timeline or restart_timeline[0]["dp"] != 2:
+            raise DrillFailure(
+                f"the restart did not resume on the WAL target topology "
+                f"(timeline: {restart_timeline})")
+
+        seen: dict = {}
+        run2 = {r["request_id"]: r for r in _terminal_records(second)}
+        for rec in _terminal_records(first):
+            rid = rec["request_id"]
+            if rid in run2 and "rejected" not in (rec["status"],
+                                                  run2[rid]["status"]):
+                raise DrillFailure(
+                    f"kill_during_resize: request {rid!r} reached a "
+                    f"terminal state in both runs ({rec['status']!r}, "
+                    f"then {run2[rid]['status']!r})")
+            seen.setdefault(rid, rec)
+        for rid, rec in run2.items():
+            seen.setdefault(rid, rec)
+        missing = [r["request_id"] for r in ktrace
+                   if r["request_id"] not in seen]
+        if missing:
+            raise DrillFailure(f"kill_during_resize: {len(missing)} "
+                               f"request(s) lost across the kill: "
+                               f"{missing}")
+        kbitwise = check_bitwise_vs_clean(kclean_by_id, seen)
+        resumed = second[-1].get("phases", {}).get("resumed_handoffs", 0)
+        if resumed < 1:
+            raise DrillFailure("the restart served the parked carries "
+                               "without resuming off their spills")
+        kill = {
+            "killed": killed,
+            "restart_dp": restart_timeline[0]["dp"],
+            "bitwise_compared": kbitwise,
+            "resumed_handoffs": resumed,
+            "replay_skipped_corrupt":
+                journal2.replay_state.skipped_corrupt,
+        }
+
+    return {
+        "n_requests": n,
+        "resizes_up": stats["resizes_up"],
+        "resizes_down": stats["resizes_down"],
+        "prewarm_ms": stats["prewarm_ms"],
+        "cutover_pause_p95_ms": stats["cutover_pause_p95_ms"],
+        "parked": stats["parked"],
+        "resumed": stats["resumed"],
+        "dropped": dropped,
+        "parity_compared": compared,
+        "parity_max_abs": max_abs,
+        **({"kill": kill} if kill else {}),
+    }
+
+
 def first_iter(gen, sink):
     """Iterate ``gen`` appending into ``sink`` — keeps the try/except at
     the call site tight while the kill can fire mid-iteration."""
@@ -1031,6 +1306,14 @@ def main(argv=None) -> int:
                          "leader's L3 insert and its terminal fsync; the "
                          "restart must serve leader+followers off the "
                          "journaled insert exactly-once, bitwise")
+    ap.add_argument("--elastic", action="store_true",
+                    help="also run the elastic resize drill (ISSUE 19): "
+                         "a seeded diurnal trace must resize dp up and "
+                         "down >= 2x each with zero drops, match the "
+                         "fixed-topology run within the documented vmap "
+                         "tolerance, and survive a chaos "
+                         "kill_during_resize with the restart resuming "
+                         "on the WAL-recorded target topology")
     ap.add_argument("--warmup", action="store_true",
                     help="one unmeasured clean pass first, so the p95 "
                          "delta is retry cost, not compile noise")
@@ -1077,6 +1360,10 @@ def main(argv=None) -> int:
             jpath = args.journal or os.path.join(
                 tempfile.mkdtemp(prefix="p2p-cachekill-"), "cache.wal")
             result["cache_kill"] = cache_insert_kill_drill(pipe, jpath)
+        if args.elastic:
+            jpath = args.journal or os.path.join(
+                tempfile.mkdtemp(prefix="p2p-elastic-"), "elastic.wal")
+            result["elastic"] = elastic_resize_drill(pipe, jpath)
     except DrillFailure as e:
         print(f"DRILL FAILED: {e}", file=sys.stderr)
         return 1

@@ -29,7 +29,13 @@ leaves arrivals, seeds and the other mixes byte-identical. ``--zipf S``
 semantic-cache content) from a Zipf(S) rank distribution over
 ``--zipf-universe`` identities on the same separate-stream discipline, so
 popular requests repeat the way real traffic does while arrivals and
-deadlines stay byte-identical to the non-zipf trace.
+deadlines stay byte-identical to the non-zipf trace. ``--diurnal``
+(ISSUE 19) modulates the poisson arrival *rate* through a sinusoidal
+day-curve — a deterministic multiplier on each drawn gap, so the base
+RNG stream is consumed identically and switching the mode off restores
+the byte-identical flat trace; the curve's phase offset rides its own
+derived stream. Elastic-serving drills use it for realistic pressure
+swings (peaks that justify a scale-up, troughs that justify a shrink).
 
     python tools/loadgen.py --n 48 --mode poisson --rate 20 --seed 0 \
         --steps 4 --out demo.jsonl
@@ -122,6 +128,35 @@ def parse_name_mix(spec: str, what: str = "mix") -> List[tuple]:
     return _parse_mix(spec, what, str)
 
 
+def parse_diurnal(spec: str) -> dict:
+    """Parse the ``--diurnal`` value: ``on`` (defaults) or a comma
+    ``k=v`` list over ``period_ms`` (one full day-curve cycle of virtual
+    time), ``low`` and ``high`` (the rate multiplier at trough/peak).
+    The defaults swing a 4 s virtual day between 0.25× and 4× the base
+    rate — wide enough that an elastic mesh crosses both its scale-up
+    and scale-down thresholds every cycle."""
+    out = {"period_ms": 4000.0, "low": 0.25, "high": 4.0}
+    s = (spec or "").strip()
+    if s not in ("", "on", "default"):
+        for part in s.split(","):
+            if "=" not in part:
+                raise ValueError(f"--diurnal expects 'on' or 'k=v,...', "
+                                 f"got {spec!r}")
+            k, v = part.split("=", 1)
+            k = k.strip()
+            if k not in out:
+                raise ValueError(f"unknown --diurnal field {k!r}; valid: "
+                                 f"{', '.join(sorted(out))}")
+            out[k] = float(v)
+    if out["period_ms"] <= 0:
+        raise ValueError(f"--diurnal period_ms must be positive, "
+                         f"got {out['period_ms']}")
+    if not 0 < out["low"] <= out["high"]:
+        raise ValueError(f"--diurnal needs 0 < low <= high, got "
+                         f"low={out['low']} high={out['high']}")
+    return out
+
+
 def generate_stream(
     duration_ms: Optional[float] = None,
     *,
@@ -141,6 +176,7 @@ def generate_stream(
     tier_mix: Optional[List[tuple]] = None,
     zipf_s: Optional[float] = None,
     zipf_universe: int = 32,
+    diurnal: Optional[dict] = None,
 ):
     """Yield request dicts in arrival order until ``arrival_ms`` would
     exceed ``duration_ms`` (and/or ``n`` requests have been produced; both
@@ -168,7 +204,18 @@ def generate_stream(
     derived RNG streams and the main stream's per-request seed draw still
     happens (discarded), so arrivals, deadlines and every other mix stay
     byte-identical to the non-zipf trace — the ``--gate-mix``
-    discipline."""
+    discipline.
+
+    ``diurnal`` (ISSUE 19, :func:`parse_diurnal` dict) modulates the
+    poisson *rate* through a sinusoidal day-curve: each drawn gap is
+    divided by a deterministic multiplier evaluated at the current
+    virtual time, so the base stream's draw order and count are
+    untouched — ``diurnal=None`` reproduces the flat trace byte-for-byte
+    (pinned in tests/test_loadgen.py). The curve's phase offset is one
+    draw on its own derived stream (the separate-stream discipline), so
+    different seeds peak at different times of "day"."""
+    import math
+
     import numpy as np
 
     if mode not in ("poisson", "burst"):
@@ -181,6 +228,29 @@ def generate_stream(
         raise ValueError(f"zipf s must be positive, got {zipf_s}")
     if zipf_universe < 1:
         raise ValueError(f"zipf universe must be >= 1, got {zipf_universe}")
+    day_mult = None
+    if diurnal is not None:
+        if mode != "poisson":
+            raise ValueError("diurnal modulates the poisson rate; "
+                             "mode 'burst' has no rate to modulate")
+        d_period = float(diurnal.get("period_ms", 4000.0))
+        d_low = float(diurnal.get("low", 0.25))
+        d_high = float(diurnal.get("high", 4.0))
+        if d_period <= 0 or not 0 < d_low <= d_high:
+            raise ValueError(f"bad diurnal spec {diurnal!r}: needs "
+                             f"period_ms > 0 and 0 < low <= high")
+        # One draw on the curve's own derived stream (the --gate-mix
+        # discipline): the phase offset, so different seeds peak at
+        # different times of "day". Everything else is a pure function
+        # of virtual time — no per-request draws, so the base stream is
+        # consumed identically with the mode on or off.
+        d_phase = float(np.random.RandomState(seed ^ 0xD1A7A1)
+                        .random_sample()) * d_period
+
+        def day_mult(t_ms):
+            x = 0.5 * (1.0 - math.cos(
+                2.0 * math.pi * (t_ms + d_phase) / d_period))
+            return d_low + (d_high - d_low) * x
 
     def _mix_drawer(mix, salt):
         # A separate derived stream per mix (the with_cancels idiom):
@@ -229,6 +299,11 @@ def generate_stream(
             # skipped) so per-request RNG consumption is uniform — the
             # prefix-stability invariant.
             gap = float(rng.exponential(1000.0 / rate_per_s))
+            if day_mult is not None:
+                # Dividing the gap by the rate multiplier at the current
+                # virtual time IS the rate modulation (thinning-free, so
+                # the base draw count never changes).
+                gap /= day_mult(at)
             if i:
                 at += gap
         else:
@@ -288,6 +363,7 @@ def generate_trace(
     tier_mix: Optional[List[tuple]] = None,
     zipf_s: Optional[float] = None,
     zipf_universe: int = 32,
+    diurnal: Optional[dict] = None,
 ) -> List[dict]:
     """Build ``n`` request dicts sorted by ``arrival_ms`` (deterministic in
     ``seed``) — the finite materialized form of :func:`generate_stream`,
@@ -306,7 +382,7 @@ def generate_trace(
         burst_gap_ms=burst_gap_ms, deadline_ms=deadline_ms,
         distinct_keys=distinct_keys, gate=gate, gate_mix=gate_mix,
         tenant_mix=tenant_mix, tier_mix=tier_mix, zipf_s=zipf_s,
-        zipf_universe=zipf_universe))
+        zipf_universe=zipf_universe, diurnal=diurnal))
 
 
 def stream_with_cancels(stream, seed: int, rate: float):
@@ -412,6 +488,16 @@ def main(argv=None) -> int:
     ap.add_argument("--zipf-universe", type=int, default=32, metavar="K",
                     help="distinct request identities under --zipf "
                          "(default 32)")
+    ap.add_argument("--diurnal", default=None, nargs="?", const="on",
+                    metavar="on|k=v,...",
+                    help="diurnal traffic mode (ISSUE 19): modulate the "
+                         "poisson rate through a sinusoidal day-curve — "
+                         "'on' or a comma list over period_ms/low/high "
+                         "(defaults 4000/0.25/4). Deterministic multiplier "
+                         "on each drawn gap: arrivals are byte-identical "
+                         "to the flat trace when the mode is off; gives "
+                         "elastic-serving drills realistic pressure "
+                         "swings (poisson only)")
     ap.add_argument("--cancel-rate", type=float, default=0.0,
                     help="interleave seeded {'cancel': id} markers at this "
                          "per-request probability (each victim cancelled "
@@ -439,6 +525,11 @@ def main(argv=None) -> int:
                   if args.tenant_mix else None)
     tier_mix = (parse_name_mix(args.tier_mix, "tier mix")
                 if args.tier_mix else None)
+    try:
+        diurnal = (parse_diurnal(args.diurnal)
+                   if args.diurnal is not None else None)
+    except ValueError as e:
+        ap.error(str(e))
     if args.duration_ms is not None:
         if args.fault_rate > 0:
             ap.error("--fault-rate needs a finite --n trace (the fault "
@@ -450,7 +541,7 @@ def main(argv=None) -> int:
             deadline_ms=args.deadline_ms, distinct_keys=args.distinct_keys,
             gate=gate, gate_mix=gate_mix, tenant_mix=tenant_mix,
             tier_mix=tier_mix, zipf_s=args.zipf,
-            zipf_universe=args.zipf_universe)
+            zipf_universe=args.zipf_universe, diurnal=diurnal)
         if args.cancel_rate > 0:
             stream = stream_with_cancels(stream, args.seed,
                                          args.cancel_rate)
@@ -469,7 +560,7 @@ def main(argv=None) -> int:
         deadline_ms=args.deadline_ms, distinct_keys=args.distinct_keys,
         gate=gate, gate_mix=gate_mix, tenant_mix=tenant_mix,
         tier_mix=tier_mix, zipf_s=args.zipf,
-        zipf_universe=args.zipf_universe)
+        zipf_universe=args.zipf_universe, diurnal=diurnal)
     if args.fault_rate > 0:
         plan_path = args.fault_plan_out or (
             args.out and args.out + ".faults.json")

@@ -639,6 +639,101 @@ def _cache_parity():
     return parity, durability
 
 
+def _elastic():
+    """The elastic-mesh serving contract (ISSUE 19), two halves:
+
+    1. **Neutrality** — the off path must carry zero elastic artifacts:
+       serving a deterministic trace without ``elastic`` must register no
+       ``serve_resizes_total`` family and journal no ``resize`` records,
+       and serving the SAME trace with an armed-but-idle controller
+       (unreachable thresholds, dp=1) must keep every ok output bitwise
+       identical to the mesh-less run, the record stream byte-identical
+       once the summary's ``mesh``/``elastic`` blocks are stripped (the
+       only record additions elastic is allowed), and the journal
+       byte-identical (an idle controller never writes one). Runs BEFORE
+       the drill so the family-absence assertion sees a registry the
+       elastic path has never touched.
+    2. **Resize drill** — ``chaos_drill.elastic_resize_drill``: a seeded
+       diurnal trace must scale up ≥2× and down ≥2× with zero dropped
+       requests, ok outputs within the documented ±1 vmap tolerance of a
+       fixed-topology run, and a ``kill_during_resize`` crash that
+       replays exactly-once, bitwise, resuming on the WAL's target
+       topology. The drill raises on any violation; the returned facts
+       let the gate insist it actually resized.
+
+    Returns ``(facts, neutral)``; ``facts`` is None when the host
+    exposes <4 devices (the drill needs dp=4 headroom)."""
+    import importlib.util
+    import json
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from p2p_tpu.obs import metrics as obs_metrics
+    from p2p_tpu.serve import (ElasticConfig, Journal, Request,
+                               serve_forever)
+
+    spec = importlib.util.spec_from_file_location(
+        "p2p_chaos_drill", os.path.join(_REPO, "tools", "chaos_drill.py"))
+    drill = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(drill)
+
+    pipe = drill.tiny_pipeline()
+    prompts = ["a squirrel eating a burger", "a squirrel eating a lasagna"]
+    reqs = [Request(request_id="el-gated", prompt=prompts[0],
+                    target=prompts[1], mode="replace", steps=3, seed=42,
+                    gate=0.5, arrival_ms=0.0),
+            Request(request_id="el-plain", prompt=prompts[0], steps=3,
+                    seed=7, arrival_ms=1.0)]
+
+    def run(tmp, elastic):
+        obs_metrics.registry().reset()
+        jpath = os.path.join(tmp, "journal.jsonl")
+        journal = Journal(jpath)
+        try:
+            recs = list(serve_forever(pipe, list(reqs), max_batch=4,
+                                      max_wait_ms=1.0, timer=lambda: 0.0,
+                                      journal=journal, elastic=elastic))
+        finally:
+            journal.close()
+        imgs = {r["request_id"]: r["images"] for r in recs
+                if r["status"] == "ok"}
+        # The summary's "mesh"/"elastic" blocks are the record additions
+        # elastic is allowed; everything else must match the off path.
+        stripped = [{k: v for k, v in r.items()
+                     if k not in ("images", "mesh", "elastic")}
+                    for r in recs]
+        with open(jpath) as f:
+            jlines = [ln.replace(tmp, "<TMP>") for ln in f]
+        return json.dumps(stripped, sort_keys=True), imgs, jlines
+
+    with tempfile.TemporaryDirectory() as t_off, \
+            tempfile.TemporaryDirectory() as t_idle:
+        off_bytes, off_imgs, off_j = run(t_off, None)
+        no_off_family = (
+            obs_metrics.registry().get("serve_resizes_total") is None)
+        # Unreachable up threshold; dp=1 cannot shrink below min_dp, so
+        # the controller is armed but never fires — pure idle overhead.
+        idle_bytes, idle_imgs, idle_j = run(
+            t_idle, ElasticConfig(up_depth=1 << 20))
+    neutral = {
+        "records_identical": off_bytes == idle_bytes,
+        "images_identical": (set(off_imgs) == set(idle_imgs) and all(
+            np.array_equal(off_imgs[k], idle_imgs[k]) for k in off_imgs)),
+        "journal_identical": off_j == idle_j,
+        "no_off_family": no_off_family,
+        "no_resize_records": not any('"resize"' in ln
+                                     for ln in off_j + idle_j),
+    }
+
+    if len(jax.devices()) < 4:
+        return None, neutral
+    jpath = os.path.join(tempfile.mkdtemp(prefix="p2p-elastic-"),
+                         "elastic.wal")
+    return drill.elastic_resize_drill(pipe, jpath), neutral
+
+
 def _soak():
     """The opt-in long-horizon soak rehearsal (ISSUE 9 acceptance): ≥500
     virtual-clock-served requests across ≥5 snapshot/compact/restart
@@ -865,6 +960,11 @@ def main(argv=None) -> int:
                     help="skip the semantic-caching check (ISSUE 13; "
                          "~30s: the zipf cached-vs-uncached parity drill "
                          "+ the kill_after_cache_insert durability drill)")
+    ap.add_argument("--skip-elastic", action="store_true",
+                    help="skip the elastic-mesh serving check (ISSUE 19; "
+                         "~2min: off-path neutrality byte-compare + the "
+                         "diurnal resize drill with kill_during_resize "
+                         "durability)")
     ap.add_argument("--soak", action="store_true",
                     help="also run the opt-in soak rehearsal (ISSUE 9): "
                          "≥500 requests across ≥5 snapshot/compact/"
@@ -906,14 +1006,15 @@ def main(argv=None) -> int:
                                        "bench_trend", "lifecycle", "soak",
                                        "mesh_parity", "slo", "cache_parity",
                                        "cost_regression", "schedule",
-                                       "kernel_parity", "profile_parity"}
+                                       "kernel_parity", "profile_parity",
+                                       "elastic"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
                      f"valid: {', '.join(cases)}, phase_gate, serve_parity, "
                      f"obs_overhead, fault_drill, static_analysis, "
                      f"flight_parity, bench_trend, lifecycle, soak, "
                      f"mesh_parity, slo, cache_parity, cost_regression, "
-                     f"schedule, kernel_parity, profile_parity")
+                     f"schedule, kernel_parity, profile_parity, elastic")
 
     drifted = []
     for name, fn in cases.items():
@@ -1162,6 +1263,46 @@ def main(argv=None) -> int:
                   f"durable insert {'ok' if ok else 'DRIFT'}")
             if not ok:
                 drifted.append("cache_parity")
+
+    if not args.skip_elastic and (only is None or "elastic" in only):
+        try:
+            res, neutral = _elastic()
+        except AssertionError as e:  # DrillFailure: an invariant broke
+            print(f"{'elastic':16s} INVARIANT VIOLATED: {e}")
+            drifted.append("elastic")
+        else:
+            neutral_ok = all(neutral.values())
+            if res is None:
+                import jax
+                ok = neutral_ok
+                print(f"{'elastic':16s} off-path neutral "
+                      f"{'ok' if neutral_ok else 'DRIFT'}; resize drill "
+                      f"skipped (<4 devices: {len(jax.devices())})")
+            else:
+                ok = (neutral_ok
+                      and res["resizes_up"] >= 2
+                      and res["resizes_down"] >= 2
+                      and res["dropped"] == 0
+                      and res["parity_compared"] > 0
+                      and res["parity_max_abs"] <= 1
+                      and res["prewarm_ms"] > 0
+                      and res["kill"]["killed"]
+                      and res["kill"]["restart_dp"] == 2
+                      and res["kill"]["resumed_handoffs"] >= 1
+                      and res["kill"]["bitwise_compared"] > 0
+                      and res["kill"]["replay_skipped_corrupt"] == 0)
+                bad = sorted(k for k, v in neutral.items() if not v)
+                print(f"{'elastic':16s} "
+                      f"{res['resizes_up']} up / {res['resizes_down']} "
+                      f"down resizes, {res['dropped']} dropped, parity "
+                      f"max|Δ|={res['parity_max_abs']} over "
+                      f"{res['parity_compared']}, kill restart on dp="
+                      f"{res['kill']['restart_dp']} resumed "
+                      f"{res['kill']['resumed_handoffs']}, off-path "
+                      + (f"NEUTRALITY DRIFT {bad}" if bad else "neutral")
+                      + f" {'ok' if ok else 'DRIFT'}")
+            if not ok:
+                drifted.append("elastic")
 
     if args.soak or (only is not None and "soak" in only):
         # Opt-in volume rehearsal — minutes of fake-runner traffic; the
