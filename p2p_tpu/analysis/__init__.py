@@ -1,6 +1,6 @@
 """jaxcheck — static analysis for the whole stack (docs/STATIC_ANALYSIS.md).
 
-Three passes, one structured report:
+The passes, one structured report:
 
 - **Pass 1 (AST lints)** — :mod:`.astlint`: repo-specific TPU/JAX rules
   over the package source, with inline ``# jaxcheck: disable=<rule>``
@@ -20,6 +20,14 @@ Three passes, one structured report:
   .DECLARED_COLLECTIVES` in both directions (undeclared collective /
   stale declaration), plus no-hidden-resharding and no-host-boundary —
   emitting the per-program bytes-per-step comms table into the report.
+- **Pass 5 (walcheck)** — :mod:`.protocol` + :mod:`.walcheck`: the serve
+  WAL protocol, declared and exhaustively crash-checked (ISSUE 20): a
+  completeness sweep over the declared record/event grammar vs the
+  write-time registry, every append site and every replay fold branch,
+  plus an exhaustive small-scope model check — a crash injected at every
+  record boundary, torn tail, and snapshot window of every bounded trace,
+  folded through the real ``serve/journal.replay`` — and three seeded
+  protocol bugs that must flip the verdict. Pure Python, no jax import.
 
 Drivers: ``tools/jaxcheck.py`` (CLI, ``--fix``, ``--update-baseline``,
 ``--only collectives``), ``p2p-tpu check --static``, and the
@@ -39,4 +47,5 @@ from .report import (  # noqa: F401
     run_ast_pass,
     run_collectives_pass,
     run_contract_pass,
+    run_wal_pass,
 )

@@ -32,6 +32,13 @@ encode the TPU/JAX invariants this codebase keeps re-learning in review:
                     ``jax.device_put`` (a hidden h2d). The lint-time twin
                     of the runtime ``jax.transfer_guard("disallow")``
                     dispatch tests.
+``unregistered-journal-record`` a ``journal.append``/``journal.event``
+                    call site whose kind literal is missing from the
+                    write-time WAL registry (``serve/journal.py``
+                    ``RECORD_KINDS``/``EVENT_KINDS``) — the lint-time
+                    twin of the write-time ``ValueError`` and the
+                    walcheck protocol sweep (docs/STATIC_ANALYSIS.md
+                    pass 5).
 ``unused-import``   dead imports (mechanical; ``--fix`` removes them).
 ``shadowed-name``   a binding that silently rebinds an imported name (or a
                     parameter that shadows a module-level import).
@@ -599,6 +606,73 @@ def _check_unguarded_transfer(ctx: ModuleContext) -> Iterator[Finding]:
                 f"{d}() in a dispatch-path module: an implicit h2d "
                 "transfer the dispatch transfer guard would reject "
                 "(stage host values via stage_host / jax.device_put)")
+
+
+def _journal_registries():
+    """The write-time WAL registries, loaded from the real
+    ``serve/journal.py`` by path (jax-free — ISSUE 20). Cached: the lint
+    runs per module."""
+    global _JOURNAL_REGS
+    if _JOURNAL_REGS is None:
+        from . import protocol
+
+        jm = protocol.load_journal()
+        _JOURNAL_REGS = (tuple(jm.RECORD_KINDS),
+                         tuple(sorted(jm.EVENT_KINDS)))
+    return _JOURNAL_REGS
+
+
+_JOURNAL_REGS = None
+
+
+def _is_journal_recv(node: ast.AST) -> bool:
+    name = node.attr if isinstance(node, ast.Attribute) else (
+        node.id if isinstance(node, ast.Name) else "")
+    return name == "journal" or name.endswith("_journal")
+
+
+@rule("unregistered-journal-record", "error",
+      "journal append/event call site writes a kind literal missing from "
+      "the WAL registry (serve/journal.py RECORD_KINDS / EVENT_KINDS)")
+def _check_unregistered_journal_record(ctx: ModuleContext
+                                       ) -> Iterator[Finding]:
+    # The write-time raise catches these at runtime; the lint catches them
+    # at review time, before any engine runs the path. Receiver must NAME
+    # a journal (``journal`` / ``*_journal``) — ``flight.event(...)`` and
+    # other event-shaped APIs never match. Non-literal kinds are skipped:
+    # the runtime validation owns them.
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and _is_journal_recv(node.func.value)):
+            continue
+        record_kinds, event_kinds = _journal_registries()
+        if node.func.attr == "event":
+            arg = node.args[0] if node.args else None
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                    and arg.value not in event_kinds:
+                yield ctx.finding(
+                    "unregistered-journal-record", node,
+                    f"journal.event({arg.value!r}) is not a registered "
+                    f"EVENT kind (registered: {', '.join(event_kinds)}) — "
+                    f"register it in serve/journal.py EVENT_KINDS and "
+                    f"declare it in analysis/protocol.DECLARED_EVENTS")
+        elif node.func.attr in ("append", "_append"):
+            arg = node.args[0] if node.args else None
+            if not isinstance(arg, ast.Dict):
+                continue
+            for k, v in zip(arg.keys, arg.values):
+                if (isinstance(k, ast.Constant) and k.value == "type"
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                        and v.value not in record_kinds):
+                    yield ctx.finding(
+                        "unregistered-journal-record", node,
+                        f"journal append of record type {v.value!r} is "
+                        f"not a registered RECORD kind (registered: "
+                        f"{', '.join(record_kinds)}) — register it in "
+                        f"serve/journal.py and declare it in "
+                        f"analysis/protocol.DECLARED_PROTOCOL")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,402 @@
+"""The serve WAL protocol, DECLARED — pass 5's contract surface (ISSUE 20).
+
+Six PRs grew the journal grammar organically (admission, dispatch,
+hand-off, preemption, cache inserts, terminals, and nine EVENT sub-kinds);
+every durability claim the ROADMAP rests on is enforced by one hand-written
+``replay()`` fold over that grammar. This module makes the grammar a
+*declaration* — the ``DECLARED_COLLECTIVES``/``DECLARED_DONATION`` pattern
+applied to the WAL:
+
+- :data:`DECLARED_PROTOCOL` is a per-request lifecycle state machine over
+  record kinds (``absent → pending → inflight ⇄ parked → done``), and
+  :data:`DECLARED_EVENTS` declares every EVENT sub-kind with its replay
+  fold target. The walcheck model checker (:mod:`.walcheck`) *generates
+  its traces from these declarations*, so a record kind cannot be declared
+  without being crash-tested.
+- :func:`check_protocol` is the completeness sweep: the declaration, the
+  write-time registry in ``serve/journal.py``, the journal append sites
+  across the package, and ``replay()``'s fold branches must all agree —
+  an undeclared kind, a stale declaration, a writer with no call site, or
+  a fold branch for a kind nobody declared are each hard errors, in both
+  directions. Extending the grammar (ROADMAP 2c multi-host leader WALs,
+  ROADMAP 3 schedule-rollout records) starts here or fails CI.
+
+Everything is pure Python over the AST plus an importlib-by-path load of
+``serve/journal.py`` (stdlib-only by design) — no jax import, so the pass
+runs in milliseconds next to the AST lints.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import importlib.util
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: Per-request lifecycle states the record state machine ranges over.
+#: ``absent`` = never admitted; ``pending`` = admitted, not dispatched;
+#: ``inflight`` = handed to a runner; ``parked`` = carry spilled at the
+#: phase boundary (hand-off or preemption), waiting to resume;
+#: ``done`` = a terminal record ended the request's life.
+STATES = ("absent", "pending", "inflight", "parked", "done")
+
+#: The marker ``from_states`` value for records that are not per-request
+#: (EVENT: loop-level, no request id).
+GLOBAL = "*"
+
+
+@dataclasses.dataclass(frozen=True)
+class RecordDecl:
+    """One declared WAL record kind and its lifecycle transition."""
+
+    kind: str
+    #: Lifecycle states the writer may append this record from
+    #: ((:data:`GLOBAL`,) for loop-level records).
+    from_states: Tuple[str, ...]
+    #: State the request moves to (``None`` = unchanged).
+    to_state: Optional[str]
+    #: ``replay()`` must fold this kind into :class:`ReplayState` (the
+    #: fold-branch sweep checks the branch exists; the model checker
+    #: checks it folds *correctly* at every crash point).
+    replay_folds: bool
+    #: The record references an on-disk spill that must be durable BEFORE
+    #: the record is appended (hand-off carries, cache result spills) —
+    #: the ordering the ``dropped-fsync`` seeded bug violates.
+    spill: bool = False
+    #: Enumeration bound: at most this many per request per trace
+    #: (``None`` = bounded only by trace depth).
+    max_per_request: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class EventDecl:
+    """One declared EVENT sub-kind."""
+
+    kind: str
+    #: :class:`ReplayState` field the event folds into (``None`` =
+    #: informational; replay reads past it). Must equal the write-time
+    #: registry's entry in ``journal.EVENT_KINDS``.
+    folds: Optional[str]
+    #: The payload field the fold reads (model traces carry it).
+    payload: Optional[str] = None
+
+
+#: The declared record grammar. Every kind ``serve/journal.py`` registers,
+#: every kind a serve-side call site appends, and every kind ``replay()``
+#: branches on must appear here — and vice versa (:func:`check_protocol`).
+DECLARED_PROTOCOL: Dict[str, RecordDecl] = {d.kind: d for d in (
+    RecordDecl("admitted", ("absent",), "pending", replay_folds=True,
+               max_per_request=1),
+    RecordDecl("dispatched", ("pending", "parked"), "inflight",
+               replay_folds=False),
+    RecordDecl("handoff", ("inflight",), "parked", replay_folds=True,
+               spill=True),
+    RecordDecl("preempted", ("inflight",), "parked", replay_folds=True,
+               spill=True),
+    RecordDecl("cache", ("inflight",), None, replay_folds=True, spill=True,
+               max_per_request=1),
+    RecordDecl("terminal", ("pending", "inflight", "parked"), "done",
+               replay_folds=True, max_per_request=1),
+    RecordDecl("event", (GLOBAL,), None, replay_folds=True),
+)}
+
+#: The declared EVENT sub-kinds — the protocol-side twin of the write-time
+#: registry ``journal.EVENT_KINDS`` (cross-checked both directions).
+DECLARED_EVENTS: Dict[str, EventDecl] = {d.kind: d for d in (
+    EventDecl("degrade", folds="degrade_level", payload="level"),
+    EventDecl("restore", folds="degrade_level", payload="level"),
+    EventDecl("resize", folds="mesh_dp", payload="new_dp"),
+    EventDecl("snapshot", folds=None),
+    EventDecl("cache_shed", folds=None),
+    EventDecl("drain", folds=None),
+    EventDecl("drain_timeout", folds=None),
+    EventDecl("fatal", folds=None),
+    EventDecl("profile_drift", folds=None),
+)}
+
+#: The crash-point catalog: every way the model checker kills the writer.
+#: ``record-boundary`` — after every durable record prefix; ``torn-tail``
+#: — a record cut mid-``write``; the three ``snapshot-*`` windows are
+#: compact()'s documented crash windows (torn ``.tmp``, snapshot durable
+#: but WAL unrotated, rotated-but-unremoved ``.old``). The chaos catalog
+#: (``serve/chaos.py``) maps each lifecycle kill kind onto one of these,
+#: and walcheck must exercise all of them or its own coverage check fails.
+CRASH_WINDOWS = ("record-boundary", "torn-tail", "snapshot-torn-tmp",
+                 "snapshot-overlap", "snapshot-stale-old")
+
+
+# ---------------------------------------------------------------------------
+# Loading the serve-side modules without importing the serve package
+# ---------------------------------------------------------------------------
+
+_MOD_CACHE: dict = {}
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _load_by_path(name: str, rel: str, root: Optional[str] = None):
+    """Import a stdlib-only serve module by file path. ``p2p_tpu.serve``'s
+    package ``__init__`` imports the engine (and with it jax); the files
+    this pass needs (``journal.py``, ``chaos.py``) are deliberately
+    stdlib-only, so loading them standalone keeps pass 5 jax-free —
+    without a second copy of the code under test: the *source file* is the
+    one the engine runs."""
+    root = root or repo_root()
+    key = (name, root)
+    if key not in _MOD_CACHE:
+        path = os.path.join(root, rel)
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        # dataclasses resolves ``cls.__module__`` through sys.modules at
+        # class-creation time, so register before exec.
+        sys.modules[name] = mod
+        try:
+            spec.loader.exec_module(mod)
+        except BaseException:
+            sys.modules.pop(name, None)
+            raise
+        _MOD_CACHE[key] = mod
+    return _MOD_CACHE[key]
+
+
+def load_journal(root: Optional[str] = None):
+    """The real ``serve/journal.py`` module (real writers, real replay)."""
+    return _load_by_path("_walcheck_journal",
+                         os.path.join("p2p_tpu", "serve", "journal.py"),
+                         root)
+
+
+def load_chaos(root: Optional[str] = None):
+    """The real ``serve/chaos.py`` module (the chaos-kind catalog)."""
+    return _load_by_path("_walcheck_chaos",
+                         os.path.join("p2p_tpu", "serve", "chaos.py"),
+                         root)
+
+
+# ---------------------------------------------------------------------------
+# Static sweeps
+# ---------------------------------------------------------------------------
+
+#: Directories scanned for journal append sites (package code only; tests
+#: construct raw records on purpose).
+APPEND_SCAN_PATHS = (os.path.join("p2p_tpu", "serve"), "p2p_tpu")
+
+
+def _is_journal_receiver(node: ast.AST) -> bool:
+    """``journal.event(...)`` / ``self._journal.terminal(...)`` — the
+    receiver's final name names a journal."""
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    else:
+        return False
+    return name == "journal" or name.endswith("_journal")
+
+
+def scan_append_sites(root: Optional[str] = None):
+    """Walk the package AST for journal writer calls. Returns
+    ``(record_sites, event_sites, dynamic_event_sites)``: record kind ->
+    list of ``path:line`` sites (via ``journal.WRITER_KINDS``), EVENT
+    literal sub-kind -> sites, and sites whose event kind is not a string
+    literal (covered by the write-time raise, invisible to staleness)."""
+    root = root or repo_root()
+    journal = load_journal(root)
+    record_sites: Dict[str, List[str]] = {}
+    event_sites: Dict[str, List[str]] = {}
+    dynamic: List[str] = []
+    pkg = os.path.join(root, "p2p_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, "r", encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError:
+                    continue
+            rel = os.path.relpath(path, root)
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in journal.WRITER_KINDS
+                        and _is_journal_receiver(node.func.value)):
+                    continue
+                site = f"{rel}:{node.lineno}"
+                kind = journal.WRITER_KINDS[node.func.attr]
+                record_sites.setdefault(kind, []).append(site)
+                if node.func.attr == "event":
+                    arg = node.args[0] if node.args else None
+                    if isinstance(arg, ast.Constant) and isinstance(
+                            arg.value, str):
+                        event_sites.setdefault(arg.value, []).append(site)
+                    else:
+                        dynamic.append(site)
+    return record_sites, event_sites, dynamic
+
+
+def scan_replay_branches(root: Optional[str] = None):
+    """Record kinds ``replay()``'s fold branches on: the names compared
+    against ``rec.get("type")`` inside ``fold_file``, resolved through the
+    module-level constants (``ADMITTED`` -> ``"admitted"``). Returns the
+    set of folded record kinds."""
+    root = root or repo_root()
+    path = os.path.join(root, "p2p_tpu", "serve", "journal.py")
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    consts: Dict[str, str] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            consts[node.targets[0].id] = node.value.value
+    folded: set = set()
+
+    def resolve(n: ast.AST) -> Optional[str]:
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            return n.value
+        if isinstance(n, ast.Name):
+            return consts.get(n.id)
+        return None
+
+    replay_fn = next((n for n in tree.body
+                      if isinstance(n, ast.FunctionDef)
+                      and n.name == "replay"), None)
+    if replay_fn is None:
+        return folded
+    for node in ast.walk(replay_fn):
+        if not (isinstance(node, ast.Compare) and len(node.ops) == 1
+                and isinstance(node.left, ast.Name)
+                and node.left.id == "kind"
+                and isinstance(node.ops[0], (ast.Eq, ast.In))):
+            continue
+        comp = node.comparators[0]
+        elts = comp.elts if isinstance(comp, (ast.Tuple, ast.List)) \
+            else [comp]
+        for elt in elts:
+            val = resolve(elt)
+            if val is not None:
+                folded.add(val)
+    return folded
+
+
+@dataclasses.dataclass
+class ProtocolVerdict:
+    """One completeness-sweep verdict (the ``FieldVerdict`` shape)."""
+
+    check: str
+    ok: bool
+    problem: str = ""
+
+    def format(self) -> str:
+        if self.ok:
+            return f"{self.check}: ok"
+        return f"{self.check}: {self.problem}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def check_protocol(root: Optional[str] = None) -> List[ProtocolVerdict]:
+    """The completeness sweep: declaration ↔ registry ↔ append sites ↔
+    replay fold branches, every edge in both directions, plus the chaos
+    catalog's crash-window mapping. Any ``ok=False`` verdict is a hard
+    error for the ``wal`` report section and the quality gate."""
+    root = root or repo_root()
+    journal = load_journal(root)
+    chaos = load_chaos(root)
+    out: List[ProtocolVerdict] = []
+
+    def verdict(check: str, problems: List[str]) -> None:
+        out.append(ProtocolVerdict(check, not problems,
+                                   "; ".join(problems)))
+
+    # 1. Declaration ↔ write-time registry (record kinds).
+    probs = []
+    declared = set(DECLARED_PROTOCOL)
+    registered = set(journal.RECORD_KINDS)
+    for k in sorted(registered - declared):
+        probs.append(f"record kind {k!r} registered in journal.RECORD_KINDS"
+                     f" but not declared in DECLARED_PROTOCOL")
+    for k in sorted(declared - registered):
+        probs.append(f"record kind {k!r} declared but not registered in "
+                     f"journal.RECORD_KINDS (stale declaration)")
+    for k, d in sorted(DECLARED_PROTOCOL.items()):
+        bad_states = (set(d.from_states) | ({d.to_state} - {None})) \
+            - set(STATES) - {GLOBAL}
+        if bad_states:
+            probs.append(f"record kind {k!r} names unknown lifecycle "
+                         f"state(s) {sorted(bad_states)}")
+    verdict("record-kinds-registered", probs)
+
+    # 2. Declaration ↔ write-time registry (event kinds + fold targets).
+    probs = []
+    ev_declared = set(DECLARED_EVENTS)
+    ev_registered = set(journal.EVENT_KINDS)
+    for k in sorted(ev_registered - ev_declared):
+        probs.append(f"event kind {k!r} registered in journal.EVENT_KINDS "
+                     f"but not declared in DECLARED_EVENTS")
+    for k in sorted(ev_declared - ev_registered):
+        probs.append(f"event kind {k!r} declared but not registered "
+                     f"(stale declaration)")
+    for k in sorted(ev_declared & ev_registered):
+        if DECLARED_EVENTS[k].folds != journal.EVENT_KINDS[k]:
+            probs.append(
+                f"event kind {k!r} fold disagrees: declared "
+                f"{DECLARED_EVENTS[k].folds!r}, registry folds into "
+                f"{journal.EVENT_KINDS[k]!r}")
+    verdict("event-kinds-registered", probs)
+
+    # 3. Append sites: every observed kind declared, every declared kind
+    #    written somewhere (stale otherwise).
+    record_sites, event_sites, _dynamic = scan_append_sites(root)
+    probs = []
+    for k in sorted(set(record_sites) - declared):
+        probs.append(f"append site(s) {record_sites[k]} write undeclared "
+                     f"record kind {k!r}")
+    for k in sorted(declared - set(record_sites)):
+        probs.append(f"declared record kind {k!r} has no journal append "
+                     f"site in the package (stale declaration)")
+    for k in sorted(set(event_sites) - ev_declared):
+        probs.append(f"append site(s) {event_sites[k]} write undeclared "
+                     f"event kind {k!r}")
+    for k in sorted(ev_declared - set(event_sites)):
+        probs.append(f"declared event kind {k!r} has no journal.event "
+                     f"call site in the package (stale declaration)")
+    verdict("append-sites-declared", probs)
+
+    # 4. Replay fold branches: every branch kind declared; every declared
+    #    record kind read by a branch (reader totality — an unbranched
+    #    kind would fall through to skipped_corrupt).
+    folded = scan_replay_branches(root)
+    probs = []
+    for k in sorted(folded - declared):
+        probs.append(f"replay() folds undeclared record kind {k!r}")
+    for k in sorted(declared - folded):
+        probs.append(f"declared record kind {k!r} has no replay() fold "
+                     f"branch (the reader would skip it as corrupt)")
+    verdict("replay-branches-declared", probs)
+
+    # 5. Chaos catalog ↔ crash-point catalog: every lifecycle kill kind's
+    #    declared crash window is one walcheck injects.
+    probs = []
+    catalog = getattr(chaos, "CATALOG", None)
+    if catalog is None:
+        probs.append("serve/chaos.py has no CATALOG table")
+    else:
+        for name, entry in sorted(catalog.items()):
+            win = entry.crash_window
+            if win is not None and win not in CRASH_WINDOWS:
+                probs.append(
+                    f"chaos kind {name!r} names crash window {win!r} not "
+                    f"in protocol.CRASH_WINDOWS {CRASH_WINDOWS}")
+    verdict("chaos-windows-covered", probs)
+    return out

@@ -6,14 +6,16 @@ consumes it, and ``p2p-tpu check --static`` wraps it. Shape:
 
 .. code-block:: json
 
-    {"version": 2,
+    {"version": 3,
      "ok": true,
      "ast": {"findings": [...], "summary": {"new": 0, ...}},
      "contracts": {"results": [...], "ok": true},
      "compile_key": {"fields": [...], "ok": true},
      "collectives": {"results": [...], "ok": true,
                      "table": {"serve/mesh-dp2": {"ops": {},
-                               "bytes_per_step": 0, ...}}}}
+                               "bytes_per_step": 0, ...}}},
+     "wal": {"protocol": [...], "model": {"crash_points": 3722, ...},
+             "seeded": [...], "ok": true}}
 
 ``ok`` is the gate verdict over the sections that ran: no *new* AST
 findings (suppressed/baselined don't count) and every contract,
@@ -32,15 +34,17 @@ from typing import Iterable, List, Optional
 from . import astlint
 from .findings import apply_baseline, load_baseline, summarize
 
-REPORT_VERSION = 2
+REPORT_VERSION = 3
 
 #: Selectable report sections (the ``only=`` vocabulary). ``ast`` is pass
 #: 1; ``contracts`` bundles the jaxpr contracts with the compile-key sweep
 #: (they share the traced canonical set); ``collectives`` is shardcheck;
 #: ``cost`` is the cost observatory's canonical pass (XLA cost cards for
 #: the canonical serve programs, diffed against the frozen budgets in
-#: ``tools/cost_budgets.json`` — ISSUE 14).
-SECTIONS = ("ast", "contracts", "collectives", "cost")
+#: ``tools/cost_budgets.json`` — ISSUE 14); ``wal`` is pass 5 (ISSUE 20):
+#: the WAL protocol completeness sweep + the exhaustive small-scope crash
+#: model checker + the seeded verdict-flips (jax-free, like ``ast``).
+SECTIONS = ("ast", "contracts", "collectives", "cost", "wal")
 
 #: Default lint targets, relative to the repo root: the package plus the
 #: drivers that embed repo invariants. tests/ is deliberately out — tests
@@ -152,6 +156,32 @@ def run_cost_pass(pipe=None, budgets_path: Optional[str] = None,
                      "ok": all(v.ok for v in verdicts)}}
 
 
+def run_wal_pass(root: Optional[str] = None, scope=None,
+                 seeded: bool = True) -> dict:
+    """Pass 5 (ISSUE 20): the WAL protocol checker — (a) the completeness
+    sweep (declaration ↔ write-time registry ↔ append sites ↔ replay fold
+    branches ↔ chaos crash windows), (b) the exhaustive small-scope crash
+    model check through the real ``replay()`` (default
+    :data:`walcheck.TIER1_SCOPE`; the pass fails on any invariant
+    violation OR on incomplete kind/window coverage), and (c) the seeded
+    verdict-flips — the three planted protocol bugs must each flip, so a
+    checker that has gone blind fails its own report. Pure Python + the
+    journal loaded by path: no jax import."""
+    from . import protocol as protocol_mod
+    from . import walcheck as walcheck_mod
+
+    verdicts = protocol_mod.check_protocol(root)
+    model = walcheck_mod.run_walcheck(
+        scope=scope or walcheck_mod.TIER1_SCOPE, root=root)
+    section = {"protocol": verdicts, "model": model,
+               "ok": all(v.ok for v in verdicts) and model["ok"]}
+    if seeded:
+        flips = walcheck_mod.run_seeded_bugs(root)
+        section["seeded"] = flips
+        section["ok"] = section["ok"] and all(f["flipped"] for f in flips)
+    return {"wal": section}
+
+
 def run_all(paths: Optional[Iterable[str]] = None,
             baseline_path: Optional[str] = None,
             root: Optional[str] = None,
@@ -209,6 +239,10 @@ def run_all(paths: Optional[Iterable[str]] = None,
         cost = run_cost_pass(pipe, root=root)
         report.update(cost)
         oks.append(cost["cost"]["ok"])
+    if "wal" in sections:
+        wal = run_wal_pass(root=root)
+        report.update(wal)
+        oks.append(wal["wal"]["ok"])
     report["ok"] = all(oks)
     return report
 
@@ -253,6 +287,14 @@ def to_json_dict(report: dict) -> dict:
             "ok": report["cost"]["ok"],
             "programs": report["cost"]["programs"],
             "budget": [v.to_dict() for v in report["cost"]["budget"]]}
+    if "wal" in report:
+        w = report["wal"]
+        out["wal"] = {
+            "ok": w["ok"],
+            "protocol": [v.to_dict() for v in w["protocol"]],
+            "model": w["model"]}
+        if "seeded" in w:
+            out["wal"]["seeded"] = w["seeded"]
     return out
 
 
@@ -318,6 +360,33 @@ def render_text(report: dict, verbose: bool = False) -> str:
             lines.append(f"    {name:26s} {card['flops']:>14.4g} | "
                          f"{card['bytes_accessed']:>14.4g} | "
                          f"{card['arith_intensity']:>7.2f}")
+    if "wal" in report:
+        w = report["wal"]
+        m = w["model"]
+        lines.append(f"WAL protocol pass: "
+                     f"{sum(1 for v in w['protocol'] if not v.ok)} sweep "
+                     f"failure(s) across {len(w['protocol'])} check(s)")
+        for v in w["protocol"]:
+            if not v.ok or verbose:
+                lines.append("  " + v.format())
+        lines.append(f"  model check [{m['scope']}]: {m['traces']} "
+                     f"trace(s), {m['crash_points']} crash point(s), "
+                     f"{len(m['violations'])} violation(s)")
+        for viol in m["violations"]:
+            lines.append(f"    {viol['invariant']} at {viol['point']} "
+                         f"({viol['window']}) of [{viol['trace']}]: "
+                         f"{viol['detail']}")
+        for missing, what in ((m["kinds_missing"], "record/event kind(s)"),
+                              (m["windows_missing"], "crash window(s)")):
+            if missing:
+                lines.append(f"    COVERAGE: {what} never exercised: "
+                             f"{missing}")
+        for flip in w.get("seeded", ()):
+            status = "flips" if flip["flipped"] else "DOES NOT FLIP"
+            lines.append(f"  seeded bug {flip['bug']}: {status}"
+                         + (f" — {flip['violation']['invariant']} at "
+                            f"{flip['counterexample']}"
+                            if flip["flipped"] else ""))
     lines.append("static analysis " + ("PASSED" if report["ok"]
                                        else "FAILED"))
     return "\n".join(lines)

@@ -1,0 +1,856 @@
+"""walcheck — exhaustive small-scope crash-consistency checking (ISSUE 20).
+
+Pass 5's dynamic half. The chaos drills sample the WAL's crash windows one
+kill at a time; this module *enumerates* them: every bounded-depth
+interleaving of protocol records for K small requests, a crash injected at
+every record boundary, every torn tail, and each of ``compact()``'s three
+documented snapshot windows — each prefix folded through the REAL
+``serve/journal.replay`` (loaded by path, no jax) and machine-checked
+against an independent pure-Python oracle. Small-scope hypothesis: a
+protocol bug that loses a request or double-serves one almost always has a
+counterexample within 2–3 requests and a handful of records, so an
+exhaustive sweep at that scope is worth more than any number of random
+fuzz seeds — and tier-1 runs it on every commit (:data:`TIER1_SCOPE`,
+also the report/gate default; the wider :data:`FULL_SCOPE` K=3 sweep is
+the ``slow``-marked test in tests/test_walcheck.py).
+
+Traces are generated FROM :data:`protocol.DECLARED_PROTOCOL` — a record
+kind cannot be declared without being crash-tested (the coverage check
+hard-errors if any declared kind or any ``protocol.CRASH_WINDOWS`` entry
+goes unexercised). Seeded verdict-flips (:data:`SEEDED_BUGS`) prove the
+checker can see: three planted protocol bugs — a dropped spill-fsync
+ordering, a terminal-before-cache reorder, a hand-off retained past its
+compact — must each flip the verdict with a failure naming the violated
+invariant and the minimal counterexample trace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import shutil
+import tempfile
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import protocol
+from .protocol import DECLARED_EVENTS, DECLARED_PROTOCOL, GLOBAL
+
+#: Short op labels for trace/counterexample strings.
+_LABEL = {"admitted": "a", "dispatched": "d", "handoff": "h",
+          "preempted": "p", "cache": "c", "terminal": "t", "event": "e",
+          "compact": "C"}
+
+#: Deterministic event payloads the executor writes and the oracle folds —
+#: one entry per declared EVENT kind (validated at run start, so declaring
+#: an event without teaching the model its payload is a hard error).
+EVENT_PAYLOADS: Dict[str, dict] = {
+    "degrade": {"level": 1},
+    "restore": {"level": 0},
+    "resize": {"new_dp": 2},
+    "snapshot": {"seq": 7},
+    "cache_shed": {},
+    "drain": {"reason": "drill"},
+    "drain_timeout": {"pending": 1},
+    "fatal": {"reason": "drill"},
+    "profile_drift": {},
+}
+
+_STATUSES = ("ok", "rejected", "expired", "timeout", "error",
+             "invalid_output", "cancelled", "shed")
+
+
+@dataclasses.dataclass(frozen=True)
+class Op:
+    """One protocol operation in a model trace."""
+
+    kind: str                       # record kind, or "compact"
+    rid: Optional[str] = None       # per-request records
+    status: Optional[str] = None    # terminal
+    event_kind: Optional[str] = None
+    payload: Optional[Tuple[Tuple[str, object], ...]] = None
+
+    def label(self) -> str:
+        tag = _LABEL.get(self.kind, self.kind)
+        if self.kind == "terminal":
+            return f"{tag}({self.rid}:{self.status})"
+        if self.kind == "event":
+            return f"{tag}({self.event_kind})"
+        if self.rid is not None:
+            return f"{tag}({self.rid})"
+        return tag
+
+    def payload_dict(self) -> dict:
+        return dict(self.payload or ())
+
+
+@dataclasses.dataclass(frozen=True)
+class Scope:
+    """Enumeration bounds — the 'small scope' the sweep is exhaustive in."""
+
+    name: str
+    #: K: traces interleave up to this many concurrent request lifecycles.
+    max_requests: int
+    #: Per-request lifecycle path length bound (records for ONE request).
+    max_path_ops: int
+    #: Total trace length bound (sum over interleaved requests).
+    max_depth: int
+    #: Terminal statuses cycled across the enumeration (all of them get
+    #: exercised as long as enough terminals are enumerated).
+    statuses: Tuple[str, ...] = _STATUSES
+    #: EVENT sub-kinds inserted (at every position) into K=1 traces.
+    event_kinds: Tuple[str, ...] = tuple(DECLARED_EVENTS)
+    #: Inject torn-tail crashes (mid-``write``) at every record.
+    torn_tails: bool = True
+    #: Run the compact sweep (snapshot∪tail ≡ full fold, at every cut) on
+    #: traces with at most this many requests.
+    compact_max_requests: int = 1
+    #: Inject the three snapshot crash windows at every compact cut.
+    compact_windows: bool = True
+
+
+#: Runs inside tier-1 on every commit: K≤2, tiny depth, all statuses, all
+#: event kinds, compact + all snapshot windows on K=1 traces.
+TIER1_SCOPE = Scope("tier1", max_requests=2, max_path_ops=4, max_depth=6)
+
+#: The quality-gate / jaxcheck scope: K≤3 interleavings, longer lifecycle
+#: paths (re-dispatch after hand-off/preemption), compact on K≤2.
+FULL_SCOPE = Scope("full", max_requests=3, max_path_ops=5, max_depth=7,
+                   compact_max_requests=2)
+
+#: Minimal scope the seeded verdict-flips run at: single request, "ok"
+#: terminals, no events — the smallest box each planted bug is visible in,
+#: so the reported counterexample is the minimal one.
+BUG_SCOPE = Scope("seeded-bug", max_requests=1, max_path_ops=5, max_depth=5,
+                  statuses=("ok",), event_kinds=(), torn_tails=True,
+                  compact_max_requests=1, compact_windows=False)
+
+
+@dataclasses.dataclass
+class Violation:
+    """One invariant violation at one crash point of one trace."""
+
+    invariant: str
+    window: str
+    trace: str
+    point: str
+    detail: str
+
+    def describe(self) -> str:
+        return (f"{self.invariant} violated at {self.point} ({self.window})"
+                f" of trace [{self.trace}]: {self.detail}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# Trace enumeration (from the declared protocol)
+# ---------------------------------------------------------------------------
+
+def request_paths(scope: Scope) -> List[Tuple[str, ...]]:
+    """All per-request record-kind paths ``absent -> done`` the declared
+    state machine admits within ``scope.max_path_ops``, shortest first."""
+    paths: List[Tuple[str, ...]] = []
+
+    def step(state: str, path: List[str]) -> None:
+        if state == "done":
+            paths.append(tuple(path))
+            return
+        if len(path) >= scope.max_path_ops:
+            return
+        for kind, d in DECLARED_PROTOCOL.items():
+            if d.from_states == (GLOBAL,) or state not in d.from_states:
+                continue
+            if d.max_per_request is not None \
+                    and path.count(kind) >= d.max_per_request:
+                continue
+            path.append(kind)
+            step(d.to_state or state, path)
+            path.pop()
+
+    step("absent", [])
+    return sorted(paths, key=lambda p: (len(p), p))
+
+
+def _instantiate(path: Tuple[str, ...], rid: str,
+                 statuses: "itertools.cycle") -> Tuple[Op, ...]:
+    ops = []
+    for kind in path:
+        if kind == "terminal":
+            ops.append(Op(kind, rid=rid, status=next(statuses)))
+        else:
+            ops.append(Op(kind, rid=rid))
+    return tuple(ops)
+
+
+def _merges(seqs: List[Tuple[Op, ...]]):
+    """All order-preserving interleavings of the given op sequences."""
+    total = sum(len(s) for s in seqs)
+    idxs = [0] * len(seqs)
+    acc: List[Op] = []
+
+    def rec():
+        if len(acc) == total:
+            yield tuple(acc)
+            return
+        for k, seq in enumerate(seqs):
+            if idxs[k] < len(seq):
+                acc.append(seq[idxs[k]])
+                idxs[k] += 1
+                yield from rec()
+                idxs[k] -= 1
+                acc.pop()
+
+    yield from rec()
+
+
+def enumerate_traces(scope: Scope) -> List[Tuple[Op, ...]]:
+    """Every bounded trace of the declared protocol at this scope, minimal
+    (shortest) first: all K-way interleavings of complete request
+    lifecycles, plus each declared EVENT kind inserted at every position
+    of every single-request trace. Incomplete lifecycles need no separate
+    enumeration — every crash prefix of a complete trace IS one."""
+    paths = request_paths(scope)
+    statuses = itertools.cycle(scope.statuses)
+    traces: List[Tuple[Op, ...]] = []
+
+    for k in range(1, scope.max_requests + 1):
+        for combo in itertools.combinations_with_replacement(paths, k):
+            if sum(len(p) for p in combo) > scope.max_depth:
+                continue
+            seqs = [_instantiate(p, f"r{i + 1}", statuses)
+                    for i, p in enumerate(combo)]
+            if k == 1:
+                traces.append(seqs[0])
+            else:
+                traces.extend(_merges(seqs))
+
+    # EVENT coverage: each declared kind inserted into every K=1 trace
+    # (loop-level records interleave with one lifecycle; the compact sweep
+    # below adds the event×snapshot interaction). Fold-bearing kinds
+    # (degrade/restore/resize) go at EVERY position — their placement
+    # changes the folded state. Informational kinds are no-ops to both the
+    # oracle and replay, so one position per trace already proves the
+    # reader reads past them (boundary + torn + compact included).
+    for path in paths:
+        base = _instantiate(path, "r1", statuses)
+        for ek in scope.event_kinds:
+            payload = tuple(sorted(EVENT_PAYLOADS[ek].items()))
+            if DECLARED_EVENTS[ek].folds is not None:
+                positions = range(len(base) + 1)
+            else:
+                positions = (len(base) // 2,)
+            for pos in positions:
+                traces.append(base[:pos]
+                              + (Op("event", event_kind=ek,
+                                    payload=payload),)
+                              + base[pos:])
+    traces.sort(key=len)
+    return traces
+
+
+def _trace_requests(ops: Tuple[Op, ...]) -> int:
+    return len({op.rid for op in ops if op.rid is not None})
+
+
+# ---------------------------------------------------------------------------
+# The oracle: an independent pure fold of a trace prefix
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Expected:
+    """What a correct restart must reconstruct from a durable prefix."""
+
+    order: List[str] = dataclasses.field(default_factory=list)
+    terminal: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: rid -> carry spill path of its LAST hand-off/preemption (includes
+    #: terminal'd rids; liveness is filtered at check time).
+    handoffs: Dict[str, str] = dataclasses.field(default_factory=dict)
+    cache: Dict[str, str] = dataclasses.field(default_factory=dict)
+    degrade_level: int = 0
+    mesh_dp: int = 0
+
+    @property
+    def pending_ids(self) -> List[str]:
+        return [r for r in self.order if r not in self.terminal]
+
+
+def fold_expected(ops: Tuple[Op, ...], paths: Dict[str, Dict[str, str]]
+                  ) -> Expected:
+    """The oracle fold. ``paths``: rid -> {"carry": .., "cache": ..} spill
+    paths the executor will use (so oracle and WAL agree byte-for-byte)."""
+    exp = Expected()
+    for op in ops:
+        if op.kind == "admitted":
+            if op.rid not in exp.order:
+                exp.order.append(op.rid)
+        elif op.kind == "terminal":
+            exp.terminal.setdefault(op.rid, op.status)
+        elif op.kind in ("handoff", "preempted"):
+            exp.handoffs[op.rid] = paths[op.rid]["carry"]
+        elif op.kind == "cache":
+            exp.cache[f"key-{op.rid}"] = paths[op.rid]["cache"]
+        elif op.kind == "event":
+            decl = DECLARED_EVENTS[op.event_kind]
+            if decl.folds is not None:
+                val = int(op.payload_dict()[decl.payload])
+                setattr(exp, decl.folds, val)
+        # "dispatched" and "compact" fold to nothing.
+    return exp
+
+
+# ---------------------------------------------------------------------------
+# Seeded verdict-flips
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SeededBug:
+    """One planted protocol bug the checker must catch (verdict flip)."""
+
+    name: str
+    #: Invariant name(s) an acceptable flip may report.
+    expected_invariants: Tuple[str, ...]
+    description: str
+    #: The executor appends spill-bearing records BEFORE their spill file
+    #: is durable (the file lands one op late) — the dropped-fsync bug.
+    defer_spills: bool = False
+    #: Trace rewrite applied before checking (protocol reorder bugs).
+    transform: Optional[Callable] = None
+    #: Applied to the snapshot file right after each compact (retention
+    #: bugs that corrupt the compactor's output).
+    snapshot_mutator: Optional[Callable] = None
+
+
+def _reorder_cache_after_terminal(ops: Tuple[Op, ...]) -> Tuple[Op, ...]:
+    out = list(ops)
+    for rid in {op.rid for op in ops if op.kind == "cache"}:
+        ci = next(i for i, op in enumerate(out)
+                  if op.kind == "cache" and op.rid == rid)
+        ti = next((i for i, op in enumerate(out)
+                   if op.kind == "terminal" and op.rid == rid), None)
+        if ti is not None and ti > ci:
+            cache_op = out.pop(ci)
+            out.insert(ti, cache_op)  # ti shifted down by the pop: lands
+            # immediately AFTER the terminal — the reordered write.
+    return tuple(out)
+
+
+def _retain_handoffs_past_compact(spath: str, exp_cut: Expected) -> None:
+    with open(spath, "r", encoding="utf-8") as f:
+        snap = json.load(f)
+    for rid in exp_cut.terminal:
+        if rid in exp_cut.handoffs:
+            snap.setdefault("handoffs", {})[rid] = {
+                "type": "handoff", "id": rid,
+                "carry_path": exp_cut.handoffs[rid], "spec": "spec-v1"}
+    with open(spath, "w", encoding="utf-8") as f:
+        json.dump(snap, f)
+
+
+SEEDED_BUGS: Tuple[SeededBug, ...] = (
+    SeededBug(
+        "dropped-fsync",
+        ("cache-spill-durable", "no-lost-handoff"),
+        "spill files become durable one op AFTER their WAL record instead "
+        "of before — a crash in between leaves a record pointing at "
+        "nothing",
+        defer_spills=True),
+    SeededBug(
+        "terminal-before-cache",
+        ("cache-before-terminal",),
+        "the semantic-cache insert record is appended after its leader's "
+        "terminal instead of before — a crash in between makes the "
+        "followers' cache hit unrecoverable",
+        transform=_reorder_cache_after_terminal),
+    SeededBug(
+        "handoff-retained-past-compact",
+        ("compact-hygiene",),
+        "compact retains hand-off records of already-terminal requests in "
+        "the snapshot — the restart would resume (re-run) finished work",
+        snapshot_mutator=_retain_handoffs_past_compact),
+)
+
+
+# ---------------------------------------------------------------------------
+# Trace execution through the real Journal
+# ---------------------------------------------------------------------------
+
+class _Boom(Exception):
+    """The simulated crash ``on_durable`` raises in the overlap window."""
+
+
+class _Executor:
+    """Drives the REAL journal writers for a trace prefix in ``workdir``,
+    honoring the spill-before-record discipline (or violating it, under
+    the dropped-fsync seeded bug)."""
+
+    def __init__(self, journal_mod, workdir: str,
+                 bug: Optional[SeededBug] = None):
+        self.jm = journal_mod
+        self.workdir = workdir
+        self.bug = bug
+        self.wal = os.path.join(workdir, "wal")
+        self.j = journal_mod.Journal(self.wal)
+        self._deferred: List[str] = []
+        self._vnow = 0.0
+        self._batch = 0
+
+    def spill_paths(self, rid: str) -> Dict[str, str]:
+        return {"carry": self.j.carry_path(rid),
+                "cache": os.path.join(self.workdir, f"cache-{rid}.bin")}
+
+    def _write_spill(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(b"spill-bytes")
+
+    def _spill_before(self, path: str) -> None:
+        if self.bug is not None and self.bug.defer_spills:
+            self._deferred.append(path)  # durable one op too late
+        else:
+            self._write_spill(path)
+
+    def apply(self, op: Op, torn: bool = False) -> None:
+        """Apply one op. ``torn=True`` models the crash landing mid-write
+        of THIS op's record: the bytes are (partially) in the file but the
+        writer never returned, so post-append side effects (the engine's
+        post-terminal ``discard_carry`` hygiene) never ran."""
+        # Flush spills the seeded dropped-fsync bug deferred: they become
+        # durable only now, one op after their record — exactly the
+        # ordering violation a crash in between exposes.
+        for path in self._deferred:
+            self._write_spill(path)
+        self._deferred.clear()
+        self._vnow += 1.0
+        j, rid = self.j, op.rid
+        if op.kind == "admitted":
+            j.admitted({"request_id": rid, "prompt": f"prompt-{rid}"},
+                       self._vnow)
+        elif op.kind == "dispatched":
+            self._batch += 1
+            j.dispatched([rid], self._batch, self._vnow)
+        elif op.kind in ("handoff", "preempted"):
+            carry = self.spill_paths(rid)["carry"]
+            self._spill_before(carry)
+            if op.kind == "handoff":
+                j.handoff(rid, self._vnow, carry, "spec-v1")
+            else:
+                j.preempted(rid, self._vnow, carry, "spec-v1", tier="batch")
+        elif op.kind == "cache":
+            cpath = self.spill_paths(rid)["cache"]
+            self._spill_before(cpath)
+            j.cache_insert(f"key-{rid}", rid, cpath, self._vnow)
+        elif op.kind == "terminal":
+            j.terminal(rid, op.status, self._vnow)
+            if not torn:
+                j.discard_carry(rid)  # the engine's post-terminal hygiene
+        elif op.kind == "event":
+            j.event(op.event_kind, **op.payload_dict())
+        else:
+            raise ValueError(f"unknown model op kind {op.kind!r}")
+        j._f.flush()  # modeled durability: bytes visible to the reader
+
+    def run(self, ops) -> None:
+        for op in ops:
+            self.apply(op)
+
+    def crash(self) -> None:
+        """Simulated kill: the file handle dies, deferred spills never
+        land, no sync/close hygiene runs."""
+        try:
+            self.j._f.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Invariant checks
+# ---------------------------------------------------------------------------
+
+#: Every invariant the checker names in a failure (the docs table).
+INVARIANTS = ("exactly-once-terminals", "pending-complete",
+              "no-lost-handoff", "cache-index-complete",
+              "cache-spill-durable", "cache-before-terminal",
+              "degrade-resume", "resize-target-restart",
+              "snapshot-tail-equivalence", "compact-hygiene")
+
+
+def _check_state(st, exp: Expected, full_ops: Tuple[Op, ...],
+                 trace_label: str, point: str, window: str,
+                 out: List[Violation]) -> None:
+    """Machine-check a replayed state against the oracle's expectation."""
+
+    def viol(inv: str, detail: str) -> None:
+        out.append(Violation(inv, window, trace_label, point, detail))
+
+    if dict(st.terminal) != exp.terminal:
+        viol("exactly-once-terminals",
+             f"replay terminal map {dict(st.terminal)!r} != expected "
+             f"{exp.terminal!r}")
+    if list(st.pending_ids) != exp.pending_ids:
+        viol("pending-complete",
+             f"replay pending {list(st.pending_ids)!r} != expected "
+             f"{exp.pending_ids!r} (a restart would lose or re-run work)")
+    for rid, carry in exp.handoffs.items():
+        if rid in exp.terminal:
+            continue  # liveness: terminal'd spills are GC'd by design
+        rec = st.handoffs.get(rid)
+        if not isinstance(rec, dict) or rec.get("carry_path") != carry:
+            viol("no-lost-handoff",
+                 f"non-terminal {rid}'s durable hand-off record is gone "
+                 f"after replay (record was appended before the crash)")
+        elif not os.path.exists(carry):
+            viol("no-lost-handoff",
+                 f"non-terminal {rid}'s carry spill {carry} is missing "
+                 f"after the replay sweep — phase-2 resume is impossible")
+    for key, cpath in exp.cache.items():
+        rec = st.cache_entries.get(key)
+        if not isinstance(rec, dict) or rec.get("path") != cpath:
+            viol("cache-index-complete",
+                 f"durable cache insert {key!r} absent from the replayed "
+                 f"cache index")
+        elif not os.path.exists(cpath):
+            viol("cache-spill-durable",
+                 f"cache entry {key!r} points at missing spill {cpath} — "
+                 f"the record outlived the bytes it references")
+    for op in full_ops:
+        if op.kind == "cache" and op.rid in st.terminal \
+                and f"key-{op.rid}" not in st.cache_entries:
+            viol("cache-before-terminal",
+                 f"leader {op.rid}'s terminal is durable but its cache "
+                 f"insert is not — the insert must be appended first")
+    if int(st.degrade_level) != exp.degrade_level:
+        viol("degrade-resume",
+             f"replay degrade_level {st.degrade_level} != expected "
+             f"{exp.degrade_level}")
+    if int(st.mesh_dp) != exp.mesh_dp:
+        viol("resize-target-restart",
+             f"replay mesh_dp {st.mesh_dp} != committed resize target "
+             f"{exp.mesh_dp} (restart would come up on the wrong mesh)")
+
+
+def _check_snapshot_hygiene(spath: str, exp_cut: Expected,
+                            trace_label: str, point: str,
+                            out: List[Violation]) -> None:
+    with open(spath, "r", encoding="utf-8") as f:
+        snap = json.load(f)
+    live = {rid for rid in exp_cut.handoffs if rid not in exp_cut.terminal}
+    stale = sorted(set(snap.get("handoffs", {})) - live)
+    if stale:
+        out.append(Violation(
+            "compact-hygiene", "compact-cut", trace_label, point,
+            f"snapshot retains hand-off record(s) {stale} for requests "
+            f"already terminal at compact time — a restart would resume "
+            f"(re-run) finished work"))
+
+
+# ---------------------------------------------------------------------------
+# Crash-point drivers
+# ---------------------------------------------------------------------------
+
+def _torn_truncate(wal: str) -> bool:
+    """Cut the WAL's last record mid-``write`` (keep half its bytes).
+    Returns False when there is nothing to tear."""
+    with open(wal, "rb") as f:
+        data = f.read()
+    body = data.rstrip(b"\n")
+    if not body:
+        return False
+    cut = body.rfind(b"\n") + 1
+    last = body[cut:]
+    if len(last) < 2:
+        return False
+    with open(wal, "wb") as f:
+        f.write(body[:cut] + last[:len(last) // 2])
+    return True
+
+
+class _Run:
+    """One walcheck sweep: enumerate, execute, crash, fold, check."""
+
+    def __init__(self, scope: Scope, root: Optional[str],
+                 bug: Optional[SeededBug], workdir: str,
+                 max_violations: int):
+        self.scope = scope
+        self.root = root
+        self.bug = bug
+        self.workdir = workdir
+        self.max_violations = max_violations
+        self.jm = protocol.load_journal(root)
+        self.violations: List[Violation] = []
+        self.windows_hit: set = set()
+        self.kinds_hit: set = set()
+        self.crash_points = 0
+        self.traces = 0
+        self._dir_seq = 0
+
+    def _full(self) -> bool:
+        return len(self.violations) >= self.max_violations
+
+    def _fresh_dir(self) -> str:
+        self._dir_seq += 1
+        d = os.path.join(self.workdir, f"cp{self._dir_seq}")
+        os.makedirs(d)
+        return d
+
+    def _start(self, ops: Tuple[Op, ...], n: int):
+        """Fresh dir + executor with the first ``n`` ops applied; returns
+        ``(ex, exps)`` where exps[i] is the oracle after i ops."""
+        d = self._fresh_dir()
+        ex = _Executor(self.jm, d, bug=self.bug)
+        paths = {op.rid: ex.spill_paths(op.rid)
+                 for op in ops if op.rid is not None}
+        exps = [fold_expected(ops[:i], paths)
+                for i in range(len(ops) + 1)]
+        ex.run(ops[:n])
+        return ex, exps
+
+    def _fold(self, ex: _Executor):
+        self.crash_points += 1
+        return self.jm.replay(ex.wal)
+
+    def _finish(self, ex: _Executor) -> None:
+        shutil.rmtree(ex.workdir, ignore_errors=True)
+
+    def check_trace(self, ops: Tuple[Op, ...]) -> None:
+        if self.bug is not None and self.bug.transform is not None:
+            ops = self.bug.transform(ops)
+        self.traces += 1
+        label = " ".join(op.label() for op in ops)
+        for op in ops:
+            self.kinds_hit.add(op.event_kind if op.kind == "event"
+                               else op.kind)
+            if op.kind == "event":
+                self.kinds_hit.add("event")
+
+        # -- crash at every record boundary --------------------------------
+        for i in range(len(ops) + 1):
+            if self._full():
+                return
+            ex, exps = self._start(ops, i)
+            ex.crash()
+            self.windows_hit.add("record-boundary")
+            st = self._fold(ex)
+            _check_state(st, exps[i], ops, label, f"boundary:{i}",
+                         "record-boundary", self.violations)
+            self._finish(ex)
+
+        # -- torn tail at every record -------------------------------------
+        if self.scope.torn_tails:
+            for i in range(len(ops)):
+                if self._full():
+                    return
+                ex, exps = self._start(ops, i)
+                ex.apply(ops[i], torn=True)
+                ex.crash()
+                if _torn_truncate(ex.wal):
+                    self.windows_hit.add("torn-tail")
+                    st = self._fold(ex)
+                    # The torn record must fold away: expected = prefix i.
+                    _check_state(st, exps[i], ops, label, f"torn:{i}",
+                                 "torn-tail", self.violations)
+                self._finish(ex)
+
+        # -- compact at every cut + the three snapshot windows -------------
+        if _trace_requests(ops) > self.scope.compact_max_requests:
+            return
+        # The three snapshot windows replay only fold-relevant WAL content
+        # at the cut; traces whose one event is informational add nothing
+        # the base trace's windows don't cover, so they get the cut-mode
+        # equivalence check but skip the (compact-heavy) window replays.
+        windows = self.scope.compact_windows and not any(
+            op.kind == "event"
+            and DECLARED_EVENTS[op.event_kind].folds is None
+            for op in ops)
+        for c in range(len(ops) + 1):
+            if self._full():
+                return
+            self._compact_cut(ops, c, label)
+            if windows:
+                self._snapshot_windows(ops, c, label)
+
+    def _compact_cut(self, ops, c: int, label: str) -> None:
+        """snapshot∪tail ≡ full-WAL fold: compact mid-trace at cut ``c``,
+        run the rest, and the restart must see exactly the full fold."""
+        ex, exps = self._start(ops, c)
+        extra = {"degrade_level": exps[c].degrade_level,
+                 "mesh_dp": exps[c].mesh_dp}
+        ex.j.compact(extra=extra)
+        spath = ex.wal + self.jm.SNAPSHOT_SUFFIX
+        if self.bug is not None and self.bug.snapshot_mutator is not None:
+            self.bug.snapshot_mutator(spath, exps[c])
+        self.crash_points += 1
+        _check_snapshot_hygiene(spath, exps[c], label, f"compact:{c}",
+                                self.violations)
+        ex.run(ops[c:])
+        ex.crash()
+        st = self.jm.replay(ex.wal)
+        before = len(self.violations)
+        _check_state(st, exps[len(ops)], ops, label, f"compact:{c}",
+                     "record-boundary", self.violations)
+        # Any divergence here IS the equivalence failure — name it too.
+        if len(self.violations) > before:
+            self.violations.append(Violation(
+                "snapshot-tail-equivalence", "record-boundary", label,
+                f"compact:{c}",
+                "snapshot∪tail fold diverges from the full-WAL fold "
+                "(see the preceding violation for the divergent field)"))
+        self._finish(ex)
+
+    def _snapshot_windows(self, ops, c: int, label: str) -> None:
+        jm = self.jm
+        # (1) crash mid-snapshot-write: only a torn .tmp exists; the WAL
+        # is untouched and the restart must fold it fully + sweep the tmp.
+        ex, exps = self._start(ops, c)
+        with open(ex.wal + jm.SNAPSHOT_SUFFIX + ".tmp", "w",
+                  encoding="utf-8") as f:
+            f.write('{"version": 1, "torn')
+        ex.crash()
+        self.windows_hit.add("snapshot-torn-tmp")
+        st = self._fold(ex)
+        _check_state(st, exps[c], ops, label, f"snap-tmp:{c}",
+                     "snapshot-torn-tmp", self.violations)
+        if not os.path.exists(ex.wal + jm.SNAPSHOT_SUFFIX + ".tmp"):
+            pass  # swept, as documented
+        else:
+            self.violations.append(Violation(
+                "snapshot-tail-equivalence", "snapshot-torn-tmp", label,
+                f"snap-tmp:{c}", "torn snapshot .tmp survived the sweep"))
+        self._finish(ex)
+
+        # (2) crash between the snapshot rename and the WAL rotation: the
+        # snapshot and the full WAL overlap; folding both must be exact
+        # (idempotent: first admission wins, duplicate terminals collapse).
+        ex, exps = self._start(ops, c)
+
+        def _die():
+            raise _Boom()
+
+        try:
+            ex.j.compact(extra={"degrade_level": exps[c].degrade_level,
+                                "mesh_dp": exps[c].mesh_dp},
+                         on_durable=_die)
+        except _Boom:
+            pass
+        if self.bug is not None and self.bug.snapshot_mutator is not None:
+            self.bug.snapshot_mutator(ex.wal + jm.SNAPSHOT_SUFFIX, exps[c])
+        ex.crash()
+        self.windows_hit.add("snapshot-overlap")
+        st = self._fold(ex)
+        _check_state(st, exps[c], ops, label, f"snap-overlap:{c}",
+                     "snapshot-overlap", self.violations)
+        self._finish(ex)
+
+        # (3) crash between rotation and old-segment removal: a stale
+        # .old whose content the snapshot subsumes; replay must sweep it
+        # and still fold exactly.
+        ex, exps = self._start(ops, c)
+        with open(ex.wal, "rb") as f:
+            pre_bytes = f.read()
+        ex.j.compact(extra={"degrade_level": exps[c].degrade_level,
+                            "mesh_dp": exps[c].mesh_dp})
+        with open(ex.wal + jm.OLD_SEGMENT_SUFFIX, "wb") as f:
+            f.write(pre_bytes)
+        ex.crash()
+        self.windows_hit.add("snapshot-stale-old")
+        st = self._fold(ex)
+        _check_state(st, exps[c], ops, label, f"snap-old:{c}",
+                     "snapshot-stale-old", self.violations)
+        if os.path.exists(ex.wal + jm.OLD_SEGMENT_SUFFIX):
+            self.violations.append(Violation(
+                "snapshot-tail-equivalence", "snapshot-stale-old", label,
+                f"snap-old:{c}",
+                "stale rotated segment survived the replay sweep"))
+        self._finish(ex)
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def run_walcheck(scope: Scope = TIER1_SCOPE, root: Optional[str] = None,
+                 bug: Optional[SeededBug] = None,
+                 workdir: Optional[str] = None,
+                 max_violations: int = 25) -> dict:
+    """The exhaustive sweep at ``scope``. Returns a summary dict:
+    ``ok`` (no violations AND full kind/window coverage), the enumerated
+    trace / crash-point counts, the violations (minimal-counterexample
+    first: traces are checked shortest-first and each trace's earliest
+    crash point first), and the coverage sets. ``bug`` plants one of
+    :data:`SEEDED_BUGS` — the verdict must flip."""
+    jm = protocol.load_journal(root)
+    bad_status = set(scope.statuses) - set(jm.TERMINAL_STATUSES)
+    if bad_status:
+        raise ValueError(f"scope statuses {sorted(bad_status)} not in "
+                         f"journal.TERMINAL_STATUSES")
+    missing_payload = set(DECLARED_EVENTS) - set(EVENT_PAYLOADS)
+    if missing_payload:
+        raise ValueError(
+            f"declared event kind(s) {sorted(missing_payload)} have no "
+            f"EVENT_PAYLOADS entry — the model cannot exercise them")
+
+    own_tmp = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="walcheck-")
+    try:
+        run = _Run(scope, root, bug, workdir, max_violations)
+        for ops in enumerate_traces(scope):
+            run.check_trace(ops)
+            if run._full() or (bug is not None and run.violations):
+                break
+    finally:
+        if own_tmp:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    required_kinds = ((set(DECLARED_PROTOCOL) - {"event"})
+                      | set(scope.event_kinds)
+                      | ({"event"} if scope.event_kinds else set()))
+    kinds_missing = sorted(required_kinds - run.kinds_hit)
+    required_windows = set(protocol.CRASH_WINDOWS)
+    if not scope.torn_tails:
+        required_windows.discard("torn-tail")
+    if not scope.compact_windows:
+        required_windows -= {"snapshot-torn-tmp", "snapshot-overlap",
+                             "snapshot-stale-old"}
+    windows_missing = sorted(required_windows - run.windows_hit)
+    complete = bug is None  # a flipped run stops early by design
+    return {
+        "scope": scope.name,
+        "traces": run.traces,
+        "crash_points": run.crash_points,
+        "violations": [v.to_dict() for v in run.violations],
+        "kinds": sorted(run.kinds_hit),
+        "kinds_missing": kinds_missing if complete else [],
+        "windows": sorted(run.windows_hit),
+        "windows_missing": windows_missing if complete else [],
+        "ok": (not run.violations
+               and (not complete
+                    or (not kinds_missing and not windows_missing))),
+    }
+
+
+def run_seeded_bugs(root: Optional[str] = None,
+                    scope: Scope = BUG_SCOPE) -> List[dict]:
+    """Run every seeded protocol bug at the minimal scope; each MUST flip
+    the verdict with a violation naming an expected invariant. Returns one
+    summary per bug with ``flipped`` and the minimal counterexample."""
+    out = []
+    for bug in SEEDED_BUGS:
+        res = run_walcheck(scope=scope, root=root, bug=bug,
+                           max_violations=5)
+        first = res["violations"][0] if res["violations"] else None
+        flipped = (first is not None
+                   and first["invariant"] in bug.expected_invariants)
+        out.append({
+            "bug": bug.name,
+            "description": bug.description,
+            "expected_invariants": list(bug.expected_invariants),
+            "flipped": flipped,
+            "violation": first,
+            "counterexample": (
+                f"trace [{first['trace']}] at {first['point']} "
+                f"({first['window']})" if first else None),
+        })
+    return out
+

@@ -591,43 +591,21 @@ def cmd_serve(args) -> int:
     if chaos is not None:
         # Some kinds are inert without their enabling flag: a drill that
         # "passes" without ever exercising the path is worse than one that
-        # fails, so say so up front.
+        # fails, so say so up front. The per-kind conditions and texts
+        # live in the chaos-kind catalog (serve/chaos.CATALOG) next to
+        # each kind's crash-window declaration.
+        from .serve.chaos import inert_warnings
+
         kinds = set(chaos.by_batch.values()) | set(chaos.by_request.values())
-        if "nan" in kinds and not args.validate_outputs:
-            print("warning: chaos plan injects 'nan' but --validate-outputs "
-                  "is off — the injection is inert and the validation path "
-                  "is NOT being drilled", file=sys.stderr)
-        if "hang" in kinds and args.watchdog_ms is None:
-            print("warning: chaos plan injects 'hang' but --watchdog-ms is "
-                  "unset — the hang degrades to a short stall and the "
-                  "watchdog path is NOT being drilled", file=sys.stderr)
-        if "kill_during_snapshot" in kinds and (
-                not args.journal or args.snapshot_every_ms is None):
-            print("warning: chaos plan arms 'kill_during_snapshot' but "
-                  "periodic snapshots are off (--journal + "
-                  "--snapshot-every-ms) — the kill can only fire at a "
-                  "drain's final snapshot", file=sys.stderr)
-        if "kill_during_drain" in kinds and "sigterm" not in kinds:
-            print("warning: chaos plan arms 'kill_during_drain' with no "
-                  "'sigterm' to start a drain — it only fires if the "
-                  "operator drains (SIGTERM/SIGINT) mid-run",
-                  file=sys.stderr)
-        if "kill_after_cache_insert" in kinds and not (args.cache
-                                                      and args.journal):
-            print("warning: chaos plan arms 'kill_after_cache_insert' but "
-                  "the insert window needs --cache AND --journal — the "
-                  "kill never fires and the durability path is NOT being "
-                  "drilled", file=sys.stderr)
-        if "kill_during_capture" in kinds and not args.profile:
-            print("warning: chaos plan arms 'kill_during_capture' but "
-                  "--profile is off — there is no capture to die inside "
-                  "and the orphan-sweep path is NOT being drilled",
-                  file=sys.stderr)
-        if "kill_during_resize" in kinds and args.elastic is None:
-            print("warning: chaos plan arms 'kill_during_resize' but "
-                  "--elastic is off — no resize ever runs, the kill "
-                  "never fires and the mid-resize crash window is NOT "
-                  "being drilled", file=sys.stderr)
+        for msg in inert_warnings(kinds, {
+                "validate_outputs": args.validate_outputs,
+                "watchdog_ms": args.watchdog_ms,
+                "journal": args.journal,
+                "snapshot_every_ms": args.snapshot_every_ms,
+                "cache": args.cache,
+                "profile": args.profile,
+                "elastic": args.elastic}):
+            print(f"warning: {msg}", file=sys.stderr)
     degrade = None
     if args.degrade_depth is not None:
         degrade = DegradeConfig(depth_threshold=args.degrade_depth,

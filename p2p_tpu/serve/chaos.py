@@ -58,10 +58,10 @@ from __future__ import annotations
 import dataclasses
 import json
 import random
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Callable, Dict, Optional, Sequence, Tuple
 
 #: Lifecycle drill kinds: intercepted by the engine before the runner —
-#: ``sigterm`` requests a graceful drain at its dispatch; the two ``kill_*``
+#: ``sigterm`` requests a graceful drain at its dispatch; the ``kill_*``
 #: kinds ARM a :class:`SimulatedKill` that fires at the next drain-mode
 #: dispatch / inside the next snapshot.
 SIGTERM = "sigterm"
@@ -93,16 +93,143 @@ KILL_DURING_CAPTURE = "kill_during_capture"
 #: resume every parked carry off its spill: exactly-once terminals,
 #: bitwise-identical ok outputs vs an uninterrupted run.
 KILL_DURING_RESIZE = "kill_during_resize"
-LIFECYCLE_KINDS = (SIGTERM, KILL_DURING_DRAIN, KILL_DURING_SNAPSHOT,
-                   PREEMPT_THEN_KILL, KILL_AFTER_CACHE_INSERT,
-                   KILL_DURING_CAPTURE, KILL_DURING_RESIZE)
 
-KINDS = ("transient", "poison", "fatal", "hang", "nan") + LIFECYCLE_KINDS
+
+@dataclasses.dataclass(frozen=True)
+class ChaosKind:
+    """One registered fault kind (ISSUE 20: the single table the kind
+    vocabulary, the CLI's inert-kill warnings and walcheck's crash-point
+    mapping all derive from — no more hand-maintained parallel lists)."""
+
+    name: str
+    #: Fires once then is spent; sticky kinds (poison/nan) keep matching
+    #: their victim id.
+    one_shot: bool
+    #: Drills the drain/snapshot machinery instead of the runner.
+    lifecycle: bool = False
+    #: The kind ARMS a deferred :class:`SimulatedKill` (``arm_kill``).
+    arms_kill: bool = False
+    #: The ``analysis/protocol.CRASH_WINDOWS`` entry this kill lands the
+    #: WAL in — the walcheck model checker injects a crash at every
+    #: instance of that window, so the one-shot drill is the sampled twin
+    #: of an exhaustively checked crash point. ``None``: not a crash
+    #: (sigterm) or a window outside the WAL protocol (the profiler's
+    #: capture ring).
+    crash_window: Optional[str] = None
+    #: ``(kinds, flags) -> warning or None``: the kind is inert without
+    #: its enabling flag(s) — a drill that "passes" without exercising
+    #: the path is worse than one that fails, so the CLI says so up
+    #: front (``inert_warnings``).
+    inert: Optional[Callable] = None
+
+
+def _inert_nan(kinds, flags):
+    if not flags.get("validate_outputs"):
+        return ("chaos plan injects 'nan' but --validate-outputs is off — "
+                "the injection is inert and the validation path is NOT "
+                "being drilled")
+
+
+def _inert_hang(kinds, flags):
+    if flags.get("watchdog_ms") is None:
+        return ("chaos plan injects 'hang' but --watchdog-ms is unset — "
+                "the hang degrades to a short stall and the watchdog path "
+                "is NOT being drilled")
+
+
+def _inert_kill_during_snapshot(kinds, flags):
+    if not flags.get("journal") or flags.get("snapshot_every_ms") is None:
+        return ("chaos plan arms 'kill_during_snapshot' but periodic "
+                "snapshots are off (--journal + --snapshot-every-ms) — "
+                "the kill can only fire at a drain's final snapshot")
+
+
+def _inert_kill_during_drain(kinds, flags):
+    if SIGTERM not in kinds:
+        return ("chaos plan arms 'kill_during_drain' with no 'sigterm' to "
+                "start a drain — it only fires if the operator drains "
+                "(SIGTERM/SIGINT) mid-run")
+
+
+def _inert_kill_after_cache_insert(kinds, flags):
+    if not (flags.get("cache") and flags.get("journal")):
+        return ("chaos plan arms 'kill_after_cache_insert' but the insert "
+                "window needs --cache AND --journal — the kill never "
+                "fires and the durability path is NOT being drilled")
+
+
+def _inert_kill_during_capture(kinds, flags):
+    if not flags.get("profile"):
+        return ("chaos plan arms 'kill_during_capture' but --profile is "
+                "off — there is no capture to die inside and the "
+                "orphan-sweep path is NOT being drilled")
+
+
+def _inert_kill_during_resize(kinds, flags):
+    if flags.get("elastic") is None:
+        return ("chaos plan arms 'kill_during_resize' but --elastic is "
+                "off — no resize ever runs, the kill never fires and the "
+                "mid-resize crash window is NOT being drilled")
+
+
+#: The chaos-kind registry. Order matters: it is the vocabulary order of
+#: ``KINDS`` (error messages, ``--fault-kinds`` docs) — runner kinds
+#: first, lifecycle kinds after, both in their historical order.
+CATALOG: Dict[str, ChaosKind] = {k.name: k for k in (
+    ChaosKind("transient", one_shot=True),
+    ChaosKind("poison", one_shot=False),
+    ChaosKind("fatal", one_shot=True),
+    ChaosKind("hang", one_shot=True, inert=_inert_hang),
+    ChaosKind("nan", one_shot=False, inert=_inert_nan),
+    ChaosKind(SIGTERM, one_shot=True, lifecycle=True),
+    ChaosKind(KILL_DURING_DRAIN, one_shot=True, lifecycle=True,
+              arms_kill=True, crash_window="record-boundary",
+              inert=_inert_kill_during_drain),
+    ChaosKind(KILL_DURING_SNAPSHOT, one_shot=True, lifecycle=True,
+              arms_kill=True, crash_window="snapshot-overlap",
+              inert=_inert_kill_during_snapshot),
+    ChaosKind(PREEMPT_THEN_KILL, one_shot=True, lifecycle=True,
+              arms_kill=True, crash_window="record-boundary",
+              inert=None),
+    ChaosKind(KILL_AFTER_CACHE_INSERT, one_shot=True, lifecycle=True,
+              arms_kill=True, crash_window="record-boundary",
+              inert=_inert_kill_after_cache_insert),
+    ChaosKind(KILL_DURING_CAPTURE, one_shot=True, lifecycle=True,
+              arms_kill=True, crash_window=None,
+              inert=_inert_kill_during_capture),
+    ChaosKind(KILL_DURING_RESIZE, one_shot=True, lifecycle=True,
+              arms_kill=True, crash_window="record-boundary",
+              inert=_inert_kill_during_resize),
+)}
+
+LIFECYCLE_KINDS = tuple(k for k, c in CATALOG.items() if c.lifecycle)
+
+KINDS = tuple(CATALOG)
 
 #: Kinds that fire once and are then spent (a flake / a single hang / one
 #: fatal / one lifecycle action). ``poison`` and ``nan`` are properties of
 #: the *request* and keep firing as long as the victim id shows up.
-_ONE_SHOT = ("transient", "hang", "fatal") + LIFECYCLE_KINDS
+_ONE_SHOT = tuple(k for k, c in CATALOG.items() if c.one_shot)
+
+#: Kinds ``arm_kill`` accepts (every lifecycle kind except ``sigterm``,
+#: which requests a graceful drain — no kill to arm).
+KILL_KINDS = tuple(k for k, c in CATALOG.items() if c.arms_kill)
+
+
+def inert_warnings(kinds: Sequence[str], flags: dict):
+    """The CLI's pre-flight check: for each kind in the plan, the warning
+    its catalog entry emits when its enabling flag(s) are off. ``flags``
+    carries the raw CLI arg values (``validate_outputs``, ``watchdog_ms``,
+    ``journal``, ``snapshot_every_ms``, ``cache``, ``profile``,
+    ``elastic``)."""
+    kinds = set(kinds)
+    out = []
+    for name, entry in CATALOG.items():
+        if name in kinds and entry.inert is not None:
+            msg = entry.inert(kinds, flags)
+            if msg:
+                out.append(msg)
+    return out
 
 
 class SimulatedKill(Exception):
@@ -157,9 +284,7 @@ class FaultPlan:
         kill itself fires later, at the matching lifecycle point (the next
         drain-mode dispatch / the next snapshot's durable moment / the
         batch-boundary sync after a forced preemption)."""
-        if kind not in (KILL_DURING_DRAIN, KILL_DURING_SNAPSHOT,
-                        PREEMPT_THEN_KILL, KILL_AFTER_CACHE_INSERT,
-                        KILL_DURING_CAPTURE, KILL_DURING_RESIZE):
+        if kind not in KILL_KINDS:
             raise ValueError(f"not a kill kind: {kind!r}")
         self._armed_kills.add(kind)
 

@@ -28,7 +28,12 @@ Everything between the durable ``resize`` record and cutover completion
 is a crash window the ``kill_during_resize`` chaos kind drills: a restart
 folds the record's ``new_dp`` out of the WAL (``ReplayState.mesh_dp``)
 and comes back *on the target topology*, replaying parked work
-exactly-once.
+exactly-once. The ``resize`` event is declared in
+``p2p_tpu.analysis.protocol.DECLARED_EVENTS`` and the restart-on-target
+fold is the ``resize-target-restart`` invariant the walcheck pass
+(ISSUE 20) machine-checks with a crash injected at every record boundary
+around the event — the chaos kind samples the window, the model check
+exhausts it.
 
 SLO awareness: a scale-down is deferred while premium-tier work is
 waiting (queued or parked) — shrinking under a premium backlog would put

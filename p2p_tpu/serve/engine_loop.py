@@ -74,7 +74,13 @@ adds a crash-safe JSONL WAL — admitted / dispatched / terminal transitions,
 fsync'd at batch boundaries — whose replay on restart reconstructs the
 queue from non-terminal entries and serves each exactly once (trace ids
 already terminal are deduped, corrupt trailing records are skipped with a
-counter). ``chaos=`` (``serve.chaos.FaultPlan``) is the deterministic
+counter). Every record kind and EVENT sub-kind this loop writes is part
+of the **declared WAL protocol**
+(``p2p_tpu.analysis.protocol.DECLARED_PROTOCOL`` /
+``DECLARED_EVENTS``, ISSUE 20): the write-time registry raises on an
+unregistered kind, and the walcheck pass crash-tests every declared
+transition at every record boundary — a new kind here must be declared
+there first, or jaxcheck's ``wal`` pass and the quality gate fail. ``chaos=`` (``serve.chaos.FaultPlan``) is the deterministic
 fault-injection hook, ``None`` in production. Under sustained queue
 pressure (``degrade=``), the loop degrades before it rejects: force
 ``gate='auto'`` on gate-less requests, then shrink the max lane bucket,

@@ -87,6 +87,47 @@ CACHE = "cache"
 TERMINAL = "terminal"
 EVENT = "event"
 
+#: ISSUE 20: the WAL grammar as ONE registry. Every record type the writer
+#: can append — :meth:`Journal._append` rejects anything else at WRITE
+#: time, so an unregistered kind is a bug at the append site, never a
+#: silently-skipped line discovered at replay. The read side stays
+#: tolerant by design (it must survive anything a crash or an operator
+#: leaves behind); the write side is strict. The declared-protocol twin
+#: of this registry lives in ``analysis/protocol.DECLARED_PROTOCOL`` and
+#: the walcheck pass cross-checks the two in both directions.
+RECORD_KINDS = (ADMITTED, DISPATCHED, HANDOFF, PREEMPTED, CACHE, TERMINAL,
+                EVENT)
+
+#: EVENT sub-kind registry: kind -> the :class:`ReplayState` field the
+#: event folds into (``None`` = informational, replay reads past it).
+#: This is the single source the writer validates against
+#: (:meth:`Journal.event` raises on an unregistered kind) AND the table
+#: :func:`replay` folds by — there is no second hand-maintained list of
+#: foldable kinds to drift. Adding an event kind means adding it here,
+#: declaring it in ``analysis/protocol.DECLARED_EVENTS``, and (if it
+#: folds) teaching the fold branch below its payload — the walcheck
+#: completeness sweep hard-errors until all three agree.
+EVENT_KINDS = {
+    "degrade":       "degrade_level",  # pressure ladder up (payload: level)
+    "restore":       "degrade_level",  # pressure ladder down (level)
+    "resize":        "mesh_dp",        # elastic cutover commit (new_dp)
+    "snapshot":      None,             # compaction bookkeeping (seq)
+    "cache_shed":    None,             # L2 eviction under pressure
+    "drain":         None,             # graceful drain began (reason)
+    "drain_timeout": None,             # drain budget expired (pending)
+    "fatal":         None,             # fatal-fault drain (reason)
+    "profile_drift": None,             # prodscope ledger drift sentinel
+}
+
+#: Writer-method name -> the record kind it appends: the static protocol
+#: sweep (``analysis/protocol.scan_append_sites``) maps ``journal.<m>()``
+#: call sites through this table, so a new writer method is part of the
+#: declared grammar or the sweep errors.
+WRITER_KINDS = {"admitted": ADMITTED, "dispatched": DISPATCHED,
+                "handoff": HANDOFF, "preempted": PREEMPTED,
+                "cache_insert": CACHE, "terminal": TERMINAL,
+                "event": EVENT}
+
 #: Snapshot sidecar (``<wal>.snapshot``) and the rotated-away segment
 #: (``<wal>.old``, transient: exists only inside compact()'s crash window).
 SNAPSHOT_SUFFIX = ".snapshot"
@@ -311,17 +352,22 @@ def replay(path: str, *, sweep: bool = True) -> ReplayState:
                         continue
                     state.cache_entries[key] = rec  # last insert wins
                 elif kind in (DISPATCHED, EVENT):
-                    # Informational for replay — except the degradation
-                    # transitions, which the warm restart resumes, and the
-                    # elastic ``resize`` commits, whose TARGET topology a
-                    # mid-resize restart must come back on.
-                    if kind == EVENT and rec.get("kind") in ("degrade",
-                                                             "restore"):
+                    # Informational for replay — except the EVENT sub-kinds
+                    # the registry marks foldable: degradation transitions
+                    # (the warm restart resumes the level) and the elastic
+                    # ``resize`` commits (whose TARGET topology a
+                    # mid-resize restart must come back on). The fold field
+                    # comes from EVENT_KINDS, so a foldable kind cannot be
+                    # registered without a fold rule here (the walcheck
+                    # model checker exercises every registered kind).
+                    folds = (EVENT_KINDS.get(rec.get("kind"))
+                             if kind == EVENT else None)
+                    if folds == "degrade_level":
                         try:
                             state.degrade_level = int(rec.get("level"))
                         except (TypeError, ValueError):
                             pass
-                    elif kind == EVENT and rec.get("kind") == "resize":
+                    elif folds == "mesh_dp":
                         try:
                             state.mesh_dp = int(rec.get("new_dp"))
                         except (TypeError, ValueError):
@@ -363,6 +409,13 @@ class Journal:
 
     # -- writers ----------------------------------------------------------
     def _append(self, rec: dict) -> None:
+        # Unregistered kinds fail HERE, at write time — a typo'd record
+        # type would otherwise be appended fine and only surface as a
+        # skipped_corrupt line at the next crash's replay (ISSUE 20).
+        if rec.get("type") not in RECORD_KINDS:
+            raise ValueError(
+                f"unregistered journal record type {rec.get('type')!r}; "
+                f"registered: {', '.join(RECORD_KINDS)}")
         self._f.write(json.dumps(rec) + "\n")
         self._dirty = True
 
@@ -445,6 +498,13 @@ class Journal:
                       "vnow_ms": round(vnow, 3)})
 
     def event(self, kind: str, **fields) -> None:
+        """Append a loop-level EVENT. ``kind`` must be registered in
+        :data:`EVENT_KINDS` — the raise happens at the append site, not as
+        a silent informational line a replay ignores forever."""
+        if kind not in EVENT_KINDS:
+            raise ValueError(
+                f"unregistered journal event kind {kind!r}; registered: "
+                f"{', '.join(sorted(EVENT_KINDS))}")
         self._append({"type": EVENT, "kind": kind, **fields})
 
     def sync(self) -> None:

@@ -30,6 +30,15 @@ and replay the identical control flow every run. :func:`signal_drain`
 wires the same flag to SIGTERM/SIGINT for the CLI: first signal = request
 a graceful drain; a second = ``KeyboardInterrupt`` (force quit — the
 journal's crash contract takes over, which is exactly what it is for).
+
+The ``drain``/``drain_timeout`` events this layer journals, and the
+crash contract the force-quit path leans on, are part of the declared
+WAL protocol (``p2p_tpu.analysis.protocol``, ISSUE 20): the walcheck
+pass replays every bounded schedule with a crash at every record
+boundary, torn tail and snapshot window — including the
+``drain_timeout``-leaves-pending-exactly-once property asserted by the
+drills here — so "the journal's crash contract takes over" is a
+machine-checked sentence, not a hopeful one.
 """
 
 from __future__ import annotations

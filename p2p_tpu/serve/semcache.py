@@ -25,8 +25,14 @@ else):
   crash: the engine journals a ``cache`` record per insert and replay
   reseeds the index (``SemCache.seed``), which is what lets a restart
   serve a killed leader's followers without recomputing (the
-  ``kill_after_cache_insert`` chaos drill). In-memory residency is
-  bounded by ``l3_bytes`` (LRU; eviction deletes the spill file too).
+  ``kill_after_cache_insert`` chaos drill). The ordering that makes it
+  sound — the ``cache`` record lands *before* the leader's terminal, so
+  no follower can dedupe against a terminal whose result never became
+  durable — is a declared invariant (``cache-before-terminal`` in
+  ``p2p_tpu.analysis.walcheck``, ISSUE 20), model-checked at every
+  crash point and guarded by the ``terminal-before-cache`` seeded bug.
+  In-memory residency is bounded by ``l3_bytes`` (LRU; eviction deletes
+  the spill file too).
 
 Single-flight collapsing (identical in-flight requests ride one leader)
 lives in the engine, not here — the cache is pure storage; the engine owns

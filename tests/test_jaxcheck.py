@@ -551,6 +551,11 @@ def test_cli_only_selector_flag_validation(tmp_path):
     assert proc.returncode == 2 and "AST pass" in proc.stderr
     proc = _run_jaxcheck(["--fix", "--only", "collectives"])
     assert proc.returncode == 2 and "--fix needs the AST pass" in proc.stderr
+    # The wal pass (pass 5) takes no lint targets and never lints.
+    proc = _run_jaxcheck(["--only", "wal", str(tmp_path)])
+    assert proc.returncode == 2 and "lint targets" in proc.stderr
+    proc = _run_jaxcheck(["--fix", "--only", "wal"])
+    assert proc.returncode == 2 and "--fix needs the AST pass" in proc.stderr
     # --ast-only is still the working shorthand for --only ast.
     good = tmp_path / "good.py"
     good.write_text("def f(x):\n    return x\n")
@@ -988,10 +993,23 @@ def test_report_verdict_flips_on_contract_class_violation(tmp_path,
     def clean_cost(*a, **kw):
         return {"cost": {"programs": {}, "budget": [], "ok": True}}
 
+    def clean_wal(*a, **kw):
+        # The real pass is exercised by test_report_wal_section below and
+        # tests/test_walcheck.py; stubbed here to keep this test on the
+        # contract leg (and off the ~7 s model check).
+        return {"wal": {"protocol": [],
+                        "model": {"scope": "stub", "traces": 0,
+                                  "crash_points": 0, "violations": [],
+                                  "kinds": [], "kinds_missing": [],
+                                  "windows": [], "windows_missing": [],
+                                  "ok": True},
+                        "ok": True}}
+
     monkeypatch.setattr(report_mod, "run_contract_pass", seeded_failure)
     monkeypatch.setattr(report_mod, "run_collectives_pass",
                         clean_collectives)
     monkeypatch.setattr(report_mod, "run_cost_pass", clean_cost)
+    monkeypatch.setattr(report_mod, "run_wal_pass", clean_wal)
     clean = tmp_path / "clean.py"
     clean.write_text("x = 1\n")
     rep = report_mod.run_all(paths=[str(clean)], baseline_path="")
@@ -1017,3 +1035,57 @@ def test_report_ok_verdict_and_json_shape(tmp_path):
     assert rep2["ok"] is True
     assert "PASSED" in report_mod.render_text(rep2)
     assert "FAILED" in report_mod.render_text(rep)
+
+
+def test_lint_unregistered_journal_record_fire_and_no_fire():
+    # Fire: a journal-named receiver writing kind literals outside the
+    # registry — both the append (record) and event shapes.
+    fired = astlint.lint_source(textwrap.dedent("""
+        def f(journal):
+            journal.append({"type": "bogus_kind", "vnow": 1})
+            journal.event("bogus_event", reason="x")
+        """), "p2p_tpu/serve/x.py",
+        rules=("unregistered-journal-record",))
+    assert [f.line for f in fired] == [3, 4]
+    assert "RECORD kind" in fired[0].message
+    assert "EVENT kind" in fired[1].message
+    # No fire: registered kinds, non-literal kinds (the write-time raise
+    # owns those), non-dict records, and non-journal receivers — the obs
+    # flight recorder has its own ``.event(...)`` API that must not match.
+    clean = astlint.lint_source(textwrap.dedent("""
+        def f(journal, shard_journal, flight, kind, rec):
+            journal.append({"type": "admitted", "vnow": 1})
+            shard_journal.event("degrade", level=1)
+            journal.event(kind)
+            journal.append(rec)
+            flight.event("anything_goes")
+        """), "p2p_tpu/serve/x.py",
+        rules=("unregistered-journal-record",))
+    assert clean == []
+
+
+def test_report_wal_section_shape_render_and_json(tmp_path):
+    # The real pass 5, end to end through the report plumbing: version 3,
+    # the wal section's verdict, the render and the JSON round-trip. The
+    # model/seeded internals are pinned in tests/test_walcheck.py.
+    assert report_mod.REPORT_VERSION == 3
+    assert report_mod.SECTIONS[-1] == "wal"
+    rep = report_mod.run_wal_pass()
+    w = rep["wal"]
+    assert w["ok"] is True
+    assert [v.check for v in w["protocol"]] == [
+        "record-kinds-registered", "event-kinds-registered",
+        "append-sites-declared", "replay-branches-declared",
+        "chaos-windows-covered"]
+    assert w["model"]["violations"] == []
+    assert w["model"]["crash_points"] > 1_000
+    assert all(f["flipped"] for f in w["seeded"])
+    full = {"version": report_mod.REPORT_VERSION, "ok": True,
+            "sections": ("wal",), **rep}
+    text = report_mod.render_text(full)
+    assert "WAL protocol pass: 0 sweep failure(s)" in text
+    assert "seeded bug dropped-fsync: flips" in text
+    doc = report_mod.to_json_dict(full)
+    json.dumps(doc)   # serializable
+    assert doc["wal"]["protocol"][0]["ok"] is True
+    assert doc["wal"]["model"]["ok"] is True

@@ -1,0 +1,166 @@
+"""walcheck — the WAL protocol sweep and crash model check (ISSUE 20).
+
+Three layers, mirroring p2p_tpu/analysis/{protocol,walcheck}.py:
+
+- the **completeness sweep**: the declared protocol vs the write-time
+  registry, append sites, replay fold branches and the chaos crash-window
+  map — clean on HEAD, and a *staleness flip in both directions* (an
+  undeclared registered kind and a declared unregistered kind each hard
+  error).
+- the **write-time registry**: ``Journal._append``/``Journal.event`` raise
+  on unregistered kinds — the runtime twin of the sweep and the
+  ``unregistered-journal-record`` lint.
+- the **model checker**: the enumerator covers every declared record kind
+  and crash window at tier-1 scope, and every seeded protocol bug flips
+  the verdict with a violation naming its expected invariant and a
+  minimal counterexample trace. The full tier-1 clean run lives in
+  tests/test_lifecycle.py (the exhaustive lifecycle leg); the larger
+  FULL_SCOPE sweep is the slow-marked test at the bottom.
+"""
+
+import dataclasses
+
+import pytest
+
+from p2p_tpu.analysis import protocol, walcheck
+from p2p_tpu.serve.journal import EVENT_KINDS, RECORD_KINDS, Journal
+
+
+# ---------------------------------------------------------------------------
+# Completeness sweep
+# ---------------------------------------------------------------------------
+
+def test_protocol_sweep_clean_on_head():
+    verdicts = protocol.check_protocol()
+    assert [v.check for v in verdicts] == [
+        "record-kinds-registered", "event-kinds-registered",
+        "append-sites-declared", "replay-branches-declared",
+        "chaos-windows-covered"]
+    bad = [v.format() for v in verdicts if not v.ok]
+    assert not bad, bad
+
+
+def test_sweep_flips_on_undeclared_registered_kind(monkeypatch):
+    # A kind registered at write time but missing from the declaration:
+    # the protocol doc has gone stale — hard error, named kind.
+    pruned = {k: d for k, d in protocol.DECLARED_PROTOCOL.items()
+              if k != "handoff"}
+    monkeypatch.setattr(protocol, "DECLARED_PROTOCOL", pruned)
+    verdicts = {v.check: v for v in protocol.check_protocol()}
+    v = verdicts["record-kinds-registered"]
+    assert not v.ok and "handoff" in v.problem
+
+
+def test_sweep_flips_on_declared_unregistered_kind(monkeypatch):
+    # The opposite direction: a declared kind nothing can ever write.
+    extra = dict(protocol.DECLARED_PROTOCOL)
+    extra["phantom"] = dataclasses.replace(
+        protocol.DECLARED_PROTOCOL["dispatched"], kind="phantom")
+    monkeypatch.setattr(protocol, "DECLARED_PROTOCOL", extra)
+    verdicts = {v.check: v for v in protocol.check_protocol()}
+    v = verdicts["record-kinds-registered"]
+    assert not v.ok and "phantom" in v.problem
+
+
+def test_sweep_flips_on_undeclared_event_kind(monkeypatch):
+    pruned = {k: d for k, d in protocol.DECLARED_EVENTS.items()
+              if k != "degrade"}
+    monkeypatch.setattr(protocol, "DECLARED_EVENTS", pruned)
+    verdicts = {v.check: v for v in protocol.check_protocol()}
+    v = verdicts["event-kinds-registered"]
+    assert not v.ok and "degrade" in v.problem
+
+
+# ---------------------------------------------------------------------------
+# Write-time registry
+# ---------------------------------------------------------------------------
+
+def test_append_raises_on_unregistered_record_kind(tmp_path):
+    with Journal(str(tmp_path / "wal.jsonl")) as j:
+        with pytest.raises(ValueError, match="bogus_kind"):
+            j._append({"type": "bogus_kind", "vnow": 0.0})
+
+
+def test_event_raises_on_unregistered_event_kind(tmp_path):
+    with Journal(str(tmp_path / "wal.jsonl")) as j:
+        with pytest.raises(ValueError, match="bogus_event"):
+            j.event("bogus_event", reason="x")
+
+
+def test_registries_match_declaration_exactly():
+    # The sweep checks this through AST + importlib; pin it in-process
+    # too so a plain pytest run catches drift without the analyzer.
+    assert set(RECORD_KINDS) == set(protocol.DECLARED_PROTOCOL)
+    assert set(EVENT_KINDS) == set(protocol.DECLARED_EVENTS)
+    for kind, decl in protocol.DECLARED_EVENTS.items():
+        assert EVENT_KINDS[kind] == decl.folds, kind
+
+
+# ---------------------------------------------------------------------------
+# Model checker: enumerator coverage and seeded verdict flips
+# ---------------------------------------------------------------------------
+
+def test_enumerator_covers_every_kind_and_status():
+    traces = walcheck.enumerate_traces(walcheck.TIER1_SCOPE)
+    kinds = {op.kind for ops in traces for op in ops}
+    assert kinds == set(protocol.DECLARED_PROTOCOL)
+    events = {op.event_kind for ops in traces for op in ops
+              if op.kind == "event"}
+    assert events == set(walcheck.TIER1_SCOPE.event_kinds)
+    statuses = {op.status for ops in traces for op in ops
+                if op.kind == "terminal"}
+    assert statuses == set(walcheck.TIER1_SCOPE.statuses)
+    # Minimal-counterexample ordering: shortest traces first.
+    lens = [len(ops) for ops in traces]
+    assert lens == sorted(lens)
+
+
+def test_interleavings_are_exhaustive_at_k2():
+    # Two two-op paths have C(4,2)=6 order-preserving merges; the model
+    # check is only "exhaustive" if the enumerator really emits them all.
+    import itertools
+
+    a = walcheck._instantiate(("admitted", "terminal"), "r1",
+                              itertools.cycle(("ok",)))
+    b = walcheck._instantiate(("admitted", "terminal"), "r2",
+                              itertools.cycle(("ok",)))
+    merges = list(walcheck._merges([a, b]))
+    assert len(merges) == 6
+    assert len(set(merges)) == 6
+
+
+def test_seeded_bugs_all_flip():
+    flips = walcheck.run_seeded_bugs()
+    assert len(flips) >= 3
+    for flip in flips:
+        assert flip["flipped"], flip
+        assert flip["violation"]["invariant"] in flip[
+            "expected_invariants"]
+        # The counterexample names the trace and the crash point.
+        assert flip["counterexample"].startswith("trace [")
+
+
+def test_seeded_bug_names_are_stable():
+    assert [b.name for b in walcheck.SEEDED_BUGS] == [
+        "dropped-fsync", "terminal-before-cache",
+        "handoff-retained-past-compact"]
+    for bug in walcheck.SEEDED_BUGS:
+        assert set(bug.expected_invariants) <= set(walcheck.INVARIANTS)
+
+
+def test_clean_run_requires_full_coverage(monkeypatch):
+    # Coverage is a hard error, not a warning: a scope that never reaches
+    # a declared kind must fail even with zero violations.
+    scope = dataclasses.replace(walcheck.BUG_SCOPE, name="starved",
+                                max_path_ops=2, max_depth=2)
+    res = walcheck.run_walcheck(scope=scope)
+    assert not res["ok"]
+    assert "handoff" in res["kinds_missing"]
+
+
+@pytest.mark.slow
+def test_full_scope_model_check_clean():
+    res = walcheck.run_walcheck(scope=walcheck.FULL_SCOPE)
+    assert res["ok"], res["violations"][:3]
+    assert not res["kinds_missing"] and not res["windows_missing"]
+    assert res["crash_points"] > 10_000

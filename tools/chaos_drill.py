@@ -1254,8 +1254,10 @@ def main(argv=None) -> int:
     ap.add_argument("--fault-rate", type=float, default=0.25)
     ap.add_argument("--cancel-rate", type=float, default=0.1)
     ap.add_argument("--fault-kinds", default="transient,poison,nan",
-                    help="comma list; add 'hang' with --watchdog-ms and "
-                         "'fatal' to drill the drain path")
+                    help="comma list from the chaos catalog "
+                         "(p2p_tpu.serve.chaos.KINDS); add 'hang' with "
+                         "--watchdog-ms and 'fatal' to drill the drain "
+                         "path")
     ap.add_argument("--trace", default=None,
                     help="drill an existing loadgen JSONL trace instead of "
                          "generating one")
@@ -1328,7 +1330,15 @@ def main(argv=None) -> int:
             trace = [json.loads(l) for l in f if l.strip()]
         plan = FaultPlan.load(args.plan)
     else:
+        from p2p_tpu.serve import chaos
+
         kinds = tuple(k for k in args.fault_kinds.split(",") if k)
+        unknown = [k for k in kinds if k not in chaos.KINDS]
+        if unknown:
+            # The catalog is the single vocabulary (ISSUE 20 satellite):
+            # a typo'd kind would silently plan zero faults of that kind.
+            ap.error(f"--fault-kinds {unknown} not in the chaos catalog "
+                     f"(known: {', '.join(chaos.KINDS)})")
         trace, plan = standard_trace(args.n, args.seed, args.steps,
                                      args.fault_rate, args.cancel_rate,
                                      kinds)

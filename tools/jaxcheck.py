@@ -1,11 +1,12 @@
 """jaxcheck — the repo's static analyzer (docs/STATIC_ANALYSIS.md).
 
-Four passes over the stack, one exit code:
+Five passes over the stack, one exit code:
 
     python tools/jaxcheck.py                  # all passes, full report
     python tools/jaxcheck.py --ast-only       # milliseconds: lints only
     python tools/jaxcheck.py --only collectives  # just the shardcheck pass
     python tools/jaxcheck.py --only cost      # cost cards vs frozen budgets
+    python tools/jaxcheck.py --only wal       # WAL protocol + crash model
     python tools/jaxcheck.py --json out.json  # structured report for CI
     python tools/jaxcheck.py --fix            # mechanical fixes in place
     python tools/jaxcheck.py --update-baseline  # accept current findings
@@ -44,13 +45,16 @@ def main(argv=None) -> int:
                     help="skip the traced-program passes (no jax import; "
                          "milliseconds) — shorthand for --only ast")
     ap.add_argument("--only", default=None,
-                    choices=("ast", "contracts", "collectives", "cost"),
+                    choices=("ast", "contracts", "collectives", "cost",
+                             "wal"),
                     help="run a single report section: 'ast' (pass 1), "
                          "'contracts' (jaxpr contracts + compile-key "
                          "sweep), 'collectives' (the shardcheck pass "
                          "alone — fast local iteration on mesh programs), "
-                         "or 'cost' (the cost observatory's canonical "
-                         "cards vs the frozen tools/cost_budgets.json)")
+                         "'cost' (the cost observatory's canonical "
+                         "cards vs the frozen tools/cost_budgets.json), "
+                         "or 'wal' (pass 5: the WAL protocol sweep + the "
+                         "exhaustive crash model check — jax-free)")
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help="baseline file (default: tools/"
                          "jaxcheck_baseline.json; '' disables)")
@@ -84,16 +88,18 @@ def main(argv=None) -> int:
         # never lints would silently wipe the file.
         ap.error("--update-baseline needs the AST pass (drop --only, or "
                  "use --only ast)")
-    if args.paths and args.only in ("contracts", "collectives", "cost"):
+    if args.paths and args.only in ("contracts", "collectives", "cost",
+                                    "wal"):
         # Honored-flags discipline: lint targets would be silently unread.
         ap.error(f"lint targets only apply to the AST pass; "
                  f"--only {args.only} takes none")
-    if args.fix and args.only in ("contracts", "collectives", "cost"):
+    if args.fix and args.only in ("contracts", "collectives", "cost",
+                                  "wal"):
         # --fix rewrites lint targets and re-lints them; a run that never
         # lints would rewrite files whose state the report never reflects.
         ap.error(f"--fix needs the AST pass (drop --only {args.only})")
 
-    if args.only != "ast":
+    if args.only not in ("ast", "wal"):
         # The traced passes import jax: pin the deterministic CPU backend
         # first (the passes are structure checks, never device work), and
         # force the virtual 8-device platform (same helper as the other
@@ -167,6 +173,8 @@ def main(argv=None) -> int:
             oks.append(report["collectives"]["ok"])
         if "cost" in report:
             oks.append(report["cost"]["ok"])
+        if "wal" in report:
+            oks.append(report["wal"]["ok"])
         report["ok"] = all(oks)
 
     print(report_mod.render_text(report, verbose=args.verbose))

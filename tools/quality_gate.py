@@ -859,6 +859,35 @@ def _static_analysis():
             len(shard_fails), shard_bytes, detail)
 
 
+def _wal_protocol():
+    """The WAL protocol checker (ISSUE 20) as its own default-on leg —
+    pass 5 is jax-free and runs apart from ``static_analysis`` so the WAL
+    verdict survives a traced-pass environment problem (and vice versa).
+    Fails on any completeness-sweep error, any model-check invariant
+    violation or coverage gap, or any seeded bug that no longer flips
+    (a checker gone blind is itself a regression)."""
+    from p2p_tpu.analysis import report as report_mod
+
+    section = report_mod.run_wal_pass()["wal"]
+    sweep = section["protocol"]
+    model = section["model"]
+    flips = section["seeded"]
+    detail = ["  " + v.format() for v in sweep if not v.ok]
+    detail += [f"  {v['invariant']} at {v['point']} ({v['window']}) of "
+               f"[{v['trace']}]: {v['detail']}"
+               for v in model["violations"]]
+    for missing, what in ((model["kinds_missing"], "record/event kind(s)"),
+                          (model["windows_missing"], "crash window(s)")):
+        if missing:
+            detail.append(f"  coverage: {what} never exercised: {missing}")
+    detail += [f"  seeded bug {f['bug']} DOES NOT FLIP" for f in flips
+               if not f["flipped"]]
+    return (section["ok"], len(sweep),
+            sum(1 for v in sweep if not v.ok), model["crash_points"],
+            len(model["violations"]),
+            sum(1 for f in flips if f["flipped"]), len(flips), detail)
+
+
 def _cost_regression(pipe, budgets_path=None):
     """The cost-observatory budget contract (ISSUE 14): compile the
     canonical serve programs, extract their XLA cost cards
@@ -986,6 +1015,11 @@ def main(argv=None) -> int:
                          "~90s: AST lints + traced-program contracts + "
                          "the compile-key completeness sweep + the "
                          "shardcheck collective-budget pass at dp=2)")
+    ap.add_argument("--skip-wal", action="store_true",
+                    help="skip the WAL protocol checker leg (ISSUE 20; "
+                         "~15s, jax-free: the declared-protocol "
+                         "completeness sweep + the exhaustive small-scope "
+                         "crash model check + the seeded verdict-flips)")
     ap.add_argument("--obs-overhead", type=float, default=1.5,
                     help="max fractional wall-clock overhead of the "
                          "metrics-enabled sampler vs disabled (ISSUE 3 "
@@ -1007,14 +1041,15 @@ def main(argv=None) -> int:
                                        "mesh_parity", "slo", "cache_parity",
                                        "cost_regression", "schedule",
                                        "kernel_parity", "profile_parity",
-                                       "elastic"}
+                                       "elastic", "wal_protocol"}
         if unknown:
             ap.error(f"unknown config(s) {sorted(unknown)}; "
                      f"valid: {', '.join(cases)}, phase_gate, serve_parity, "
                      f"obs_overhead, fault_drill, static_analysis, "
                      f"flight_parity, bench_trend, lifecycle, soak, "
                      f"mesh_parity, slo, cache_parity, cost_regression, "
-                     f"schedule, kernel_parity, profile_parity, elastic")
+                     f"schedule, kernel_parity, profile_parity, elastic, "
+                     f"wal_protocol")
 
     drifted = []
     for name, fn in cases.items():
@@ -1345,6 +1380,18 @@ def main(argv=None) -> int:
             print(line)
         if not ok:
             drifted.append("static_analysis")
+
+    if not args.skip_wal and (only is None or "wal_protocol" in only):
+        (ok, n_sweep, bad_sweep, crash_points, n_viol, flipped, n_bugs,
+         detail) = _wal_protocol()
+        print(f"{'wal_protocol':16s} {bad_sweep}/{n_sweep} protocol sweep "
+              f"failure(s), {n_viol} violation(s) across {crash_points} "
+              f"model-checked crash point(s), {flipped}/{n_bugs} seeded "
+              f"bug(s) flip {'ok' if ok else 'DRIFT'}")
+        for line in detail:
+            print(line)
+        if not ok:
+            drifted.append("wal_protocol")
 
     if drifted:
         print(f"QUALITY GATE FAILED: {', '.join(drifted)} "
